@@ -1,0 +1,41 @@
+import pytest
+
+from wukong_tpu import types
+from wukong_tpu.config import GlobalConfig
+from wukong_tpu.utils.errors import ErrorCode, WukongError, assert_ec
+
+
+def test_id_space_split():
+    assert types.PREDICATE_ID == 0
+    assert types.TYPE_ID == 1
+    assert types.NORMAL_ID_START == 1 << 17
+    assert types.is_idx_id(5)
+    assert not types.is_idx_id(1 << 17)
+    assert types.is_var(-3)
+    assert not types.is_var(7)
+
+
+def test_dirs():
+    assert types.IN == 0 and types.OUT == 1
+    assert types.reverse_dir(types.IN) == types.OUT
+    assert types.reverse_dir(types.OUT) == types.IN
+
+
+def test_config_parse_and_immutability():
+    cfg = GlobalConfig()
+    cfg.finalize()
+    cfg.load_str("global_num_engines 16\nglobal_mt_threshold 64\n# comment\n")
+    assert cfg.num_engines == 16
+    assert cfg.mt_threshold == 16  # clamped to num_engines
+    cfg.load_str("global_silent off", runtime=True)
+    assert cfg.silent is False
+    with pytest.raises(ValueError):
+        cfg.load_str("global_num_engines 2", runtime=True)
+    with pytest.raises(KeyError):
+        cfg.set("no_such_key", "1")
+
+
+def test_error_codes():
+    with pytest.raises(WukongError) as e:
+        assert_ec(False, ErrorCode.VERTEX_INVALID, "col missing")
+    assert e.value.code == ErrorCode.VERTEX_INVALID

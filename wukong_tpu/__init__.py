@@ -1,0 +1,33 @@
+"""wukong-tpu: a TPU-native distributed RDF store + SPARQL graph-exploration engine.
+
+A from-scratch rebuild of the capability surface of SJTU-IPADS/Wukong (OSDI'16)
+designed for TPU hardware: CSR-encoded predicate segments staged into HBM,
+batched gather/expand kernels (JAX/XLA/Pallas) for triple-pattern matching, and
+pjit/shard_map all-to-all exchanges over ICI in place of RDMA fork-join.
+
+Package layout:
+  wukong_tpu.types     — ID model (sid/ssid, reserved ids, triple model)
+  wukong_tpu.config    — Global runtime config (reference: core/global.hpp, core/config.hpp)
+  wukong_tpu.utils     — logger / timer / errors / math helpers
+  wukong_tpu.store     — CSR graph store, string server, checker (reference: core/store)
+  wukong_tpu.loader    — dataset loaders + datagen (reference: core/loader, datagen/)
+  wukong_tpu.sparql    — lexer/parser/IR (reference: core/SPARQL*.hpp, parser.hpp, query.hpp)
+  wukong_tpu.engine    — CPU oracle engine + TPU engine (reference: core/engine, core/gpu)
+  wukong_tpu.planner   — type-centric stats + optimizer (reference: core/stats.hpp, planner.hpp)
+  wukong_tpu.parallel  — device mesh, sharded store, all-to-all exchange (reference: core/comm)
+  wukong_tpu.runtime   — proxy, console, monitor, emulator (reference: core/proxy.hpp, console.hpp)
+"""
+
+__version__ = "0.1.0"
+
+from wukong_tpu.types import (  # noqa: F401
+    PREDICATE_ID,
+    TYPE_ID,
+    NBITS_IDX,
+    BLANK_ID,
+    IN,
+    OUT,
+    Triple,
+    is_idx_id,
+    is_var,
+)

@@ -1,0 +1,120 @@
+"""Global runtime configuration.
+
+Mirrors the reference's two-tier config (core/global.hpp:29-124, core/config.hpp:42-235):
+key-value settings loaded from a config file or string, split into settings that are
+immutable after boot and settings that can be reloaded at runtime via the console
+``config -s`` command (config.hpp:183-198). Derived invariants are recomputed on every
+load (config.hpp:220-235).
+
+TPU-specific additions replace the RDMA/GPU knobs: device-engine enablement, binding
+table capacity classes, and all-to-all shuffle capacities.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, fields
+
+
+@dataclass
+class GlobalConfig:
+    # ---- immutable after boot (config.hpp:42-110) ----
+    num_workers: int = 1  # graph partitions (reference: num_servers)
+    num_proxies: int = 1
+    num_engines: int = 4  # host executor threads per worker
+    input_folder: str = ""
+    memstore_size_gb: int = 4
+    est_bdr_threshold: int = 0  # reserved (reference RDMA buffer sizing)
+    enable_tpu: bool = True  # accelerator engine on (reference: USE_GPU path)
+    tpu_mem_cache_gb: int = 8  # HBM segment-cache budget (reference: gpu_kvcache)
+    enable_dynamic_store: bool = False  # append-only delta segments
+    enable_versatile: bool = True  # variable-predicate support (USE_VERSATILE)
+
+    # ---- mutable at runtime (config.hpp:112-151) ----
+    enable_planner: bool = True
+    enable_vattr: bool = False  # attribute-triple queries
+    enable_corun: bool = False
+    silent: bool = True  # blind mode: don't ship result tables to the proxy
+    mt_threshold: int = 8  # max fan-out slices for heavy index-origin queries
+    rdma_threshold: int = 300  # rows >= threshold -> fork-join (dist shuffle)
+    stealing_pattern: int = 0  # 0: pair, 1: ring (host engine work stealing)
+    enable_budget: bool = True
+    gpu_enable_pipeline: bool = True  # prefetch next pattern's segments to HBM
+
+    # ---- TPU-engine knobs (new; no reference analogue) ----
+    table_capacity_min: int = 1024  # smallest binding-table capacity class
+    table_capacity_max: int = 1 << 22  # largest capacity class before spill
+    exchange_capacity: int = 1 << 16  # per-destination all-to-all row budget
+    device_batch: int = 1024  # queries compiled together (emulator batch dim)
+
+    # ---- derived (recomputed by finalize; config.hpp:220-235) ----
+    num_threads: int = field(default=0, init=False)
+
+    _IMMUTABLE = {
+        "num_workers", "num_proxies", "num_engines", "input_folder",
+        "memstore_size_gb", "est_bdr_threshold", "enable_tpu", "tpu_mem_cache_gb",
+        "enable_dynamic_store", "enable_versatile",
+    }
+
+    def finalize(self) -> None:
+        self.num_threads = self.num_proxies + self.num_engines
+        # mt_threshold never exceeds engine count (config.hpp:231)
+        self.mt_threshold = max(1, min(self.mt_threshold, self.num_engines))
+
+    def set(self, key: str, value: str, runtime: bool = False) -> None:
+        """Set one key from its string form. runtime=True rejects immutable keys."""
+        key = key.removeprefix("global_")
+        valid = {f.name for f in fields(self) if f.init}
+        if key not in valid:
+            raise KeyError(f"unknown config item: {key}")
+        if runtime and key in self._IMMUTABLE:
+            raise ValueError(f"config item '{key}' is immutable at runtime")
+        cur = getattr(self, key)
+        if isinstance(cur, bool):
+            setattr(self, key, value.strip().lower() in ("1", "true", "yes", "on"))
+        elif isinstance(cur, int):
+            setattr(self, key, int(value))
+        else:
+            setattr(self, key, value.strip())
+        self.finalize()
+
+    def load_str(self, text: str, runtime: bool = False) -> None:
+        """Parse 'key value' lines (comments with #) — config.hpp:152-181."""
+        for line in text.splitlines():
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            parts = line.split(None, 1)
+            if len(parts) != 2:
+                raise ValueError(f"malformed config line: {line!r}")
+            self.set(parts[0], parts[1], runtime=runtime)
+
+    def load_file(self, path: str, runtime: bool = False) -> None:
+        with open(path) as f:
+            self.load_str(f.read(), runtime=runtime)
+
+    def dump(self) -> str:
+        out = []
+        for f in fields(self):
+            if f.init:
+                out.append(f"global_{f.name}\t{getattr(self, f.name)}")
+        return "\n".join(out)
+
+
+# process-wide singleton, mirroring `Global::*` statics (global.hpp:29-74)
+Global = GlobalConfig()
+Global.finalize()
+
+
+def load_config(path: str, num_workers: int | None = None) -> GlobalConfig:
+    """Boot-time load (config.hpp:203-218): file + worker count from the launcher."""
+    Global.load_file(path)
+    if num_workers is not None:
+        Global.num_workers = num_workers
+    Global.finalize()
+    return Global
+
+
+def reload_config(text: str) -> GlobalConfig:
+    """Runtime reload of mutable settings (config.hpp:183-198)."""
+    Global.load_str(text, runtime=True)
+    return Global

@@ -1,0 +1,132 @@
+"""ID model and triple model.
+
+Mirrors the reference's type system (core/type.hpp:28-127, core/store/vertex.hpp:34-43,
+datagen/generate_data.cpp:50-52):
+
+- ``sid`` (string id): unsigned vertex/predicate/type id. We use int64 host-side and
+  int32 on device (LUBM-10240 has ~1.4B triples but < 2^31 vertices).
+- ``ssid`` (signed string id): query-side id — variables are NEGATIVE, constants
+  POSITIVE (core/type.hpp:31).
+- The id space is split: ids < 2^NBITS_IDX (= 2^17) are *index* ids (predicates and
+  types); ids >= 2^17 are *normal* vertices (datagen/generate_data.cpp:50, 117-123).
+- Reserved index ids: PREDICATE_ID=0 (``__PREDICATE__`` — the predicate index),
+  TYPE_ID=1 (``rdf:type`` — the type index) (core/store/vertex.hpp:34-43).
+- BLANK_ID marks OPTIONAL-unmatched cells in binding tables (core/type.hpp:33).
+
+Directions (core/type.hpp:127): IN=0, OUT=1. A triple (s, p, o) is reachable both as
+(s, p, OUT) -> o and (o, p, IN) -> s; the store indexes both.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# Reserved ids and id-space split
+# ---------------------------------------------------------------------------
+
+PREDICATE_ID = 0  # "__PREDICATE__" — predicate-index id
+TYPE_ID = 1  # rdf:type — type-index id
+NBITS_IDX = 17  # ids < 2**NBITS_IDX are index (predicate/type) ids
+NORMAL_ID_START = 1 << NBITS_IDX
+
+# Device arrays are int32; BLANK_ID is the max unsigned 32-bit value in the
+# reference (core/type.hpp:33). We keep tables as int32 on device, so BLANK_ID
+# maps to -1 (all-ones); host-side code treats both views equivalently.
+BLANK_ID = (1 << 32) - 1  # uint32 view (reference value)
+BLANK_ID_I32 = -1  # int32 device view (same bit pattern)
+
+# dtypes
+SID_DTYPE = np.int64  # host-side id arrays (room for 64-bit build)
+DEVICE_SID_DTYPE = np.int32  # device-side binding tables / CSR arrays
+
+
+class Dir(enum.IntEnum):
+    """Edge direction (core/type.hpp:127). CORUN is an optimizer hint."""
+
+    IN = 0
+    OUT = 1
+    CORUN = 2
+
+
+IN = Dir.IN
+OUT = Dir.OUT
+CORUN = Dir.CORUN
+
+
+def reverse_dir(d: int) -> int:
+    return Dir.OUT if d == Dir.IN else Dir.IN
+
+
+# ---------------------------------------------------------------------------
+# Attribute value types (utils/variant.hpp:28-50)
+# ---------------------------------------------------------------------------
+
+
+class AttrType(enum.IntEnum):
+    SID_t = 0
+    INT_t = 1
+    FLOAT_t = 2
+    DOUBLE_t = 3
+
+
+# ---------------------------------------------------------------------------
+# ssid helpers: variables are negative, constants positive
+# ---------------------------------------------------------------------------
+
+
+def is_var(ssid: int) -> bool:
+    """Variables are encoded as negative ids (core/type.hpp:31)."""
+    return ssid < 0
+
+
+def is_const(ssid: int) -> bool:
+    return ssid > 0
+
+
+def is_idx_id(sid: int) -> bool:
+    """True for predicate/type (index) ids, False for normal vertex ids."""
+    return 0 <= sid < NORMAL_ID_START
+
+
+def is_tpid(ssid: int) -> bool:
+    """'type or predicate id' — positive and inside the index id space."""
+    return 0 < ssid < NORMAL_ID_START or ssid == PREDICATE_ID
+
+
+# ---------------------------------------------------------------------------
+# Triple model
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Triple:
+    """An id-encoded RDF triple (core/type.hpp:42-50)."""
+
+    s: int
+    p: int
+    o: int
+
+
+@dataclass(frozen=True)
+class AttrTriple:
+    """An attribute triple: subject, attr predicate, typed value (core/type.hpp:52-60)."""
+
+    s: int
+    a: int
+    type: int  # AttrType tag
+    v: object  # int | float
+
+
+def triples_to_array(triples) -> np.ndarray:
+    """Pack an iterable of (s, p, o) into an [N,3] int64 array."""
+    arr = np.asarray(
+        [(t.s, t.p, t.o) if isinstance(t, Triple) else tuple(t) for t in triples],
+        dtype=SID_DTYPE,
+    )
+    if arr.size == 0:
+        arr = arr.reshape(0, 3)
+    return arr

@@ -1,0 +1,3 @@
+from wukong_tpu.utils.errors import WukongError, ErrorCode  # noqa: F401
+from wukong_tpu.utils.logger import logstream, set_log_level  # noqa: F401
+from wukong_tpu.utils.timer import get_usec  # noqa: F401

@@ -1,0 +1,55 @@
+"""Error codes surfaced to clients on query replies.
+
+Mirrors utils/errors.hpp:28-79 — engine-side failures do not kill workers; they
+become a ``status_code`` on the reply, and the frontend renders a message.
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class ErrorCode(enum.IntEnum):
+    SUCCESS = 0
+    SYNTAX_ERROR = 1  # parser-level failure
+    UNKNOWN_SUB = 2  # unknown subject string
+    UNKNOWN_PATTERN = 3  # pattern shape not supported by the engine
+    ATTR_DISABLE = 4  # attribute query while vattr support disabled
+    NO_REQUIRED_VAR = 5  # projection references an unbound variable
+    UNSUPPORT_UNION = 6
+    OBJ_ERROR = 7  # malformed index pattern
+    VERTEX_INVALID = 8  # known var has no bound column
+    UNKNOWN_FILTER = 9
+    FIRST_PATTERN_ERROR = 10  # start pattern must begin an empty table
+    UNKNOWN_PLAN = 11
+
+
+_MESSAGES = {
+    ErrorCode.SUCCESS: "success",
+    ErrorCode.SYNTAX_ERROR: "syntax error",
+    ErrorCode.UNKNOWN_SUB: "unknown subject (not in string server)",
+    ErrorCode.UNKNOWN_PATTERN: "unsupported triple pattern",
+    ErrorCode.ATTR_DISABLE: "attribute support is disabled (enable_vattr)",
+    ErrorCode.NO_REQUIRED_VAR: "projection variable is not bound",
+    ErrorCode.UNSUPPORT_UNION: "unsupported UNION shape",
+    ErrorCode.OBJ_ERROR: "malformed index pattern",
+    ErrorCode.VERTEX_INVALID: "known variable has no bound column",
+    ErrorCode.UNKNOWN_FILTER: "unsupported FILTER expression",
+    ErrorCode.FIRST_PATTERN_ERROR: "start pattern applied to a non-empty table",
+    ErrorCode.UNKNOWN_PLAN: "invalid or missing query plan",
+}
+
+
+class WukongError(Exception):
+    """Query-scoped failure carrying an ErrorCode (utils/errors.hpp WukongException)."""
+
+    def __init__(self, code: ErrorCode, detail: str = ""):
+        self.code = ErrorCode(code)
+        self.detail = detail
+        msg = _MESSAGES.get(self.code, "unknown error")
+        super().__init__(f"[{self.code.name}] {msg}" + (f": {detail}" if detail else ""))
+
+
+def assert_ec(cond: bool, code: ErrorCode, detail: str = "") -> None:
+    if not cond:
+        raise WukongError(code, detail)

@@ -1,0 +1,93 @@
+"""Leveled logger (reference: utils/logger2.hpp — 8 levels, runtime-settable).
+
+Console command ``logger <level>`` adjusts the level at runtime across workers.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+# reference levels (logger2.hpp:112-119)
+LOG_EVERYTHING = 0
+LOG_DEBUG = 1
+LOG_INFO = 2
+LOG_EMPH = 3
+LOG_WARNING = 4
+LOG_ERROR = 5
+LOG_FATAL = 6
+LOG_NONE = 7
+
+_LEVEL_NAMES = {
+    LOG_EVERYTHING: "ALL",
+    LOG_DEBUG: "DEBUG",
+    LOG_INFO: "INFO",
+    LOG_EMPH: "EMPH",
+    LOG_WARNING: "WARN",
+    LOG_ERROR: "ERROR",
+    LOG_FATAL: "FATAL",
+}
+
+_COLORS = {
+    LOG_DEBUG: "\033[36m",
+    LOG_INFO: "",
+    LOG_EMPH: "\033[1;32m",
+    LOG_WARNING: "\033[1;33m",
+    LOG_ERROR: "\033[1;31m",
+    LOG_FATAL: "\033[1;41m",
+}
+_RESET = "\033[0m"
+
+_current_level = LOG_INFO
+_t0 = time.time()
+
+
+def set_log_level(level: int) -> None:
+    global _current_level
+    _current_level = int(level)
+
+
+def get_log_level() -> int:
+    return _current_level
+
+
+class _Stream:
+    def __init__(self, level: int):
+        self.level = level
+
+    def __lshift__(self, msg):  # logstream(LOG_INFO) << "msg" style
+        self.write(str(msg))
+        return self
+
+    def write(self, msg: str) -> None:
+        if self.level < _current_level:
+            return
+        name = _LEVEL_NAMES.get(self.level, "?")
+        color = _COLORS.get(self.level, "") if sys.stderr.isatty() else ""
+        reset = _RESET if color else ""
+        ts = time.time() - _t0
+        sys.stderr.write(f"{color}[{ts:9.3f}s {name:5s}]{reset} {msg}\n")
+
+
+def logstream(level: int) -> _Stream:
+    return _Stream(level)
+
+
+def log_debug(msg: str) -> None:
+    _Stream(LOG_DEBUG).write(msg)
+
+
+def log_info(msg: str) -> None:
+    _Stream(LOG_INFO).write(msg)
+
+
+def log_emph(msg: str) -> None:
+    _Stream(LOG_EMPH).write(msg)
+
+
+def log_warn(msg: str) -> None:
+    _Stream(LOG_WARNING).write(msg)
+
+
+def log_error(msg: str) -> None:
+    _Stream(LOG_ERROR).write(msg)
