@@ -1,0 +1,57 @@
+from wukong_tpu.loader.datagen import convert_dir
+from wukong_tpu.types import NORMAL_ID_START
+
+
+NT = """\
+<http://a.org/s1> <http://www.w3.org/1999/02/22-rdf-syntax-ns#type> <http://a.org/T1> .
+<http://a.org/s1> <http://a.org/knows> <http://a.org/s2> .
+<http://a.org/s2> <http://a.org/knows> <http://a.org/s1> .
+<http://a.org/s1> <http://a.org/age> "40"^^<http://www.w3.org/2001/XMLSchema#int> .
+"""
+
+
+def test_convert_dir(tmp_path):
+    src = tmp_path / "nt"
+    src.mkdir()
+    (src / "f0.nt").write_text(NT)
+    dst = tmp_path / "id"
+    meta = convert_dir(str(src), str(dst))
+    assert meta["index_vertex"] == 4  # __PREDICATE__, rdf:type, T1? no: knows + type + T1
+    # id triples: 3 normal rows
+    rows = [tuple(map(int, l.split("\t")))
+            for l in (dst / "id_f0.nt").read_text().splitlines()]
+    assert len(rows) == 3
+    s2i = {}
+    for line in (dst / "str_normal").read_text().splitlines():
+        s, i = line.rsplit("\t", 1)
+        s2i[s] = int(i)
+    for line in (dst / "str_index").read_text().splitlines():
+        s, i = line.rsplit("\t", 1)
+        s2i[s] = int(i)
+    assert s2i["__PREDICATE__"] == 0
+    assert s2i["<http://www.w3.org/1999/02/22-rdf-syntax-ns#type>"] == 1
+    assert s2i["<http://a.org/T1>"] < NORMAL_ID_START  # type object -> index id
+    assert s2i["<http://a.org/s1>"] >= NORMAL_ID_START
+    # type triple encodes the type as an index id
+    t_row = [r for r in rows if r[1] == 1][0]
+    assert t_row == (s2i["<http://a.org/s1>"], 1, s2i["<http://a.org/T1>"])
+    # attr triple extracted with type tag 1 (int)
+    attr = (dst / "attr_f0.nt").read_text().splitlines()
+    assert len(attr) == 1
+    sid, pid, t, val = attr[0].split("\t")
+    assert int(t) == 1 and val == "40"
+    # str_attr_index records the attr predicate
+    assert "<http://a.org/age>" in (dst / "str_attr_index").read_text()
+
+
+def test_prefix_expansion(tmp_path):
+    src = tmp_path / "nt"
+    src.mkdir()
+    (src / "f0.nt").write_text(
+        "@prefix ex: <http://ex.org/> .\n"
+        "ex:a <http://ex.org/p> ex:b .\n"
+    )
+    dst = tmp_path / "id"
+    convert_dir(str(src), str(dst))
+    normal = (dst / "str_normal").read_text()
+    assert "<http://ex.org/a>" in normal and "<http://ex.org/b>" in normal

@@ -1,0 +1,105 @@
+import numpy as np
+import pytest
+
+from wukong_tpu.loader import lubm
+from wukong_tpu.loader.lubm import (
+    P,
+    T,
+    VirtualLubmStrings,
+    generate_lubm,
+    lubm_counts,
+    lubm_layout,
+    write_dataset,
+)
+from wukong_tpu.types import NORMAL_ID_START, TYPE_ID
+
+
+@pytest.fixture(scope="module")
+def lubm1():
+    return generate_lubm(1, seed=42)
+
+
+def test_determinism():
+    t1, _ = generate_lubm(1, seed=7)
+    t2, _ = generate_lubm(1, seed=7)
+    assert np.array_equal(t1, t2)
+    t3, _ = generate_lubm(1, seed=8)
+    assert not np.array_equal(t1, t3)
+
+
+def test_id_spaces(lubm1):
+    triples, lay = lubm1
+    s, p, o = triples[:, 0], triples[:, 1], triples[:, 2]
+    assert (s >= NORMAL_ID_START).all()  # subjects are normal vertices
+    assert (p < NORMAL_ID_START).all() and (p >= 1).all()  # predicates are index ids
+    # objects: type triples -> index ids, others -> normal ids
+    is_type = p == TYPE_ID
+    assert (o[is_type] < NORMAL_ID_START).all()
+    assert (o[~is_type] >= NORMAL_ID_START).all()
+    assert (s < lay.id_end).all() and (o < lay.id_end).all()
+
+
+def test_cardinalities(lubm1):
+    triples, lay = lubm1
+    c = lay.counts
+    p, o = triples[:, 1], triples[:, 2]
+    is_type = p == TYPE_ID
+    type_counts = {t: int((o[is_type] == t).sum()) for t in set(T.values())}
+    assert type_counts[T["University"]] == 1
+    assert type_counts[T["Department"]] == c.D
+    assert 15 <= c.D <= 25
+    assert type_counts[T["FullProfessor"]] == int(c.n_fp.sum())
+    assert type_counts[T["UndergraduateStudent"]] == int(c.n_ug.sum())
+    assert type_counts[T["Course"]] == int(c.n_course.sum())
+    # every faculty worksFor exactly one department
+    n_fac = int(c.n_fac.sum())
+    assert int((p == P["worksFor"]).sum()) == n_fac
+    # UG takesCourse between 2 and 4 (duplicates may reduce but >= 1)
+    tc = triples[p == P["takesCourse"]]
+    ug_tc = tc[tc[:, 0] < lay.gs_base.min()]
+    per_student = np.bincount(ug_tc[:, 0] - ug_tc[:, 0].min())
+    per_student = per_student[per_student > 0]
+    assert per_student.min() >= 1 and per_student.max() <= 4
+
+
+def test_virtual_strings_roundtrip(lubm1):
+    triples, lay = lubm1
+    vs = VirtualLubmStrings(1, seed=42)
+    rng = np.random.default_rng(0)
+    ids = np.unique(np.concatenate([triples[:, 0], triples[:, 2]]))
+    sample = rng.choice(ids, size=200, replace=False)
+    for vid in sample:
+        s = vs.id2str(int(vid))
+        assert vs.str2id(s) == int(vid), (vid, s)
+    # well-known query constants resolve
+    assert vs.str2id("<http://www.University0.edu>") == lay.univ_base
+    assert vs.str2id("<http://www.Department0.University0.edu>") == int(lay.dept_id[0])
+    d0fp0 = vs.str2id("<http://www.Department0.University0.edu/FullProfessor0>")
+    assert d0fp0 == int(lay.fac_base[0])
+    with pytest.raises(KeyError):
+        vs.str2id("<http://www.University999.edu>")
+    with pytest.raises(KeyError):
+        vs.str2id("<http://nonsense>")
+
+
+def test_write_dataset_roundtrip(tmp_path):
+    meta = write_dataset(str(tmp_path), 1, seed=3, fmt="npy")
+    tri = np.load(tmp_path / "id_triples.npy")
+    assert len(tri) == meta["num_triples"]
+    assert (tmp_path / "str_index").exists()
+    assert (tmp_path / "str_normal_virtual").exists()
+    # text format matches npy content
+    write_dataset(str(tmp_path / "txt"), 1, seed=3, fmt="text")
+    rows = []
+    for f in sorted((tmp_path / "txt").glob("id_uni*.nt")):
+        for line in f.read_text().splitlines():
+            rows.append(tuple(int(x) for x in line.split("\t")))
+    assert sorted(rows) == sorted(map(tuple, tri.tolist()))
+
+
+def test_index_strings_table():
+    rows = lubm.index_strings()
+    assert rows[0] == ("__PREDICATE__", 0)
+    assert rows[1][1] == 1
+    ids = [i for _, i in rows]
+    assert ids == list(range(len(ids)))  # dense, in order

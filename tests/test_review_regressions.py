@@ -1,0 +1,41 @@
+"""Regression tests for review findings on the data layer."""
+
+import pytest
+
+from wukong_tpu.config import GlobalConfig
+from wukong_tpu.loader.lubm import VirtualLubmStrings
+from wukong_tpu.types import is_tpid
+
+
+def test_config_clamp_order_independent():
+    a = GlobalConfig(); a.finalize()
+    a.load_str("global_mt_threshold 64\nglobal_num_engines 16")
+    b = GlobalConfig(); b.finalize()
+    b.load_str("global_num_engines 16\nglobal_mt_threshold 64")
+    assert a.mt_threshold == b.mt_threshold == 16
+
+
+def test_config_unknown_key_warns_and_continues():
+    cfg = GlobalConfig(); cfg.finalize()
+    cfg.load_str("global_silent off\nglobal_not_a_real_knob 1\nglobal_mt_threshold 2")
+    assert cfg.silent is False and cfg.mt_threshold == 2
+
+
+def test_config_bad_value_applies_nothing():
+    cfg = GlobalConfig(); cfg.finalize()
+    before = cfg.silent
+    with pytest.raises(ValueError):
+        cfg.load_str("global_silent off\nglobal_mt_threshold banana")
+    assert cfg.silent is before
+
+
+def test_virtual_strings_out_of_range_email():
+    vs = VirtualLubmStrings(1)
+    assert not vs.exist('"email0@Department0.University99.edu"')
+    assert not vs.exist('"email0@Department99.University0.edu"')
+    assert not vs.exist('"email9999999@Department0.University0.edu"')
+
+
+def test_is_tpid_excludes_reserved():
+    assert not is_tpid(0) and not is_tpid(1)
+    assert is_tpid(2) and not is_tpid(1 << 17)

@@ -1,0 +1,142 @@
+import numpy as np
+import pytest
+
+from wukong_tpu.loader.lubm import P, T, generate_lubm, write_dataset
+from wukong_tpu.store.checker import check_cross_partition, check_partition
+from wukong_tpu.store.gstore import build_all_partitions, build_partition
+from wukong_tpu.store.segment import CSRSegment
+from wukong_tpu.store.string_server import StringServer
+from wukong_tpu.types import IN, OUT, PREDICATE_ID, TYPE_ID
+
+
+@pytest.fixture(scope="module")
+def lubm1():
+    return generate_lubm(1, seed=42)
+
+
+@pytest.fixture(scope="module")
+def stores(lubm1):
+    triples, _ = lubm1
+    return build_all_partitions(triples, 4)
+
+
+def test_csr_segment_basics():
+    k = np.array([5, 3, 5, 3, 9], dtype=np.int64)
+    v = np.array([1, 2, 4, 2, 7], dtype=np.int64)
+    seg = CSRSegment.from_pairs(k, v)
+    assert seg.keys.tolist() == [3, 5, 9]
+    assert seg.lookup(3).tolist() == [2]  # deduped
+    assert seg.lookup(5).tolist() == [1, 4]
+    assert seg.lookup(42).tolist() == []
+    start, deg = seg.lookup_many(np.array([3, 42, 9]))
+    assert deg.tolist() == [1, 0, 1]
+    ok = seg.contains_pair(np.array([5, 5, 3, 42]), np.array([4, 2, 2, 1]))
+    assert ok.tolist() == [True, False, True, False]
+
+
+def test_partition_covers_all_triples(lubm1, stores):
+    triples, _ = lubm1
+    # total OUT edges across partitions == unique triples
+    uniq = len(np.unique(triples.view([("s", np.int64), ("p", np.int64), ("o", np.int64)])))
+    total_out = sum(
+        seg.num_edges for g in stores for (pid, d), seg in g.segments.items() if d == OUT
+    )
+    assert total_out == uniq
+
+
+def test_lookup_semantics(lubm1, stores):
+    triples, lay = lubm1
+    s, p, o = triples[:, 0], triples[:, 1], triples[:, 2]
+    # pick a professor and check worksFor
+    fp0 = int(lay.fac_base[0])
+    g = stores[fp0 % 4]
+    dept = g.get_triples(fp0, P["worksFor"], OUT)
+    assert dept.tolist() == [int(lay.dept_id[0])]
+    # reverse direction from the department's owner
+    gd = stores[int(lay.dept_id[0]) % 4]
+    members = gd.get_triples(int(lay.dept_id[0]), P["worksFor"], IN)
+    expected = np.sort(s[(p == P["worksFor"]) & (o == lay.dept_id[0])])
+    assert members.tolist() == expected.tolist()
+    # type list
+    types = g.get_triples(fp0, TYPE_ID, OUT)
+    assert types.tolist() == [T["FullProfessor"]]
+
+
+def test_type_index_distributed(lubm1, stores):
+    triples, lay = lubm1
+    s, p, o = triples[:, 0], triples[:, 1], triples[:, 2]
+    t_fp = T["FullProfessor"]
+    all_fps = np.sort(s[(p == TYPE_ID) & (o == t_fp)])
+    got = np.sort(np.concatenate([g.get_index(t_fp, IN) for g in stores]))
+    assert got.tolist() == all_fps.tolist()
+    # each member lives on its subject-hash owner
+    for g in stores:
+        members = g.get_index(t_fp, IN)
+        assert (members % 4 == g.sid).all()
+
+
+def test_pred_index(lubm1, stores):
+    triples, _ = lubm1
+    s, p, o = triples[:, 0], triples[:, 1], triples[:, 2]
+    pid = P["advisor"]
+    subj = np.unique(s[p == pid])
+    got = np.sort(np.concatenate([g.get_index(pid, IN) for g in stores]))
+    assert got.tolist() == subj.tolist()
+    obj = np.unique(o[p == pid])
+    got_o = np.sort(np.concatenate([g.get_index(pid, OUT) for g in stores]))
+    assert got_o.tolist() == obj.tolist()
+
+
+def test_versatile_pred_lists(lubm1, stores):
+    triples, lay = lubm1
+    fp0 = int(lay.fac_base[0])
+    g = stores[fp0 % 4]
+    preds = g.get_triples(fp0, PREDICATE_ID, OUT)
+    assert TYPE_ID in preds  # OUT list includes rdf:type
+    assert P["worksFor"] in preds and P["teacherOf"] in preds
+    # IN pred list of a department: no TYPE_ID (type triples skipped on pos side)
+    d0 = int(lay.dept_id[0])
+    gd = stores[d0 % 4]
+    in_preds = gd.get_triples(d0, PREDICATE_ID, IN)
+    assert TYPE_ID not in in_preds
+    assert P["worksFor"] in in_preds and P["memberOf"] in in_preds
+
+
+def test_gsck_clean(stores):
+    for g in stores:
+        assert check_partition(g) == []
+    assert check_cross_partition(stores) == []
+
+
+def test_gsck_detects_corruption(lubm1):
+    triples, _ = lubm1
+    g = build_partition(triples, 0, 1)
+    # corrupt: drop a vertex from a type index list
+    key = next(k for k in g.index if k[0] in g.type_ids and len(g.index[k]) > 2)
+    g.index[key] = g.index[key][:-1]
+    assert any("missing from tidx" in e for e in check_partition(g))
+
+
+def test_string_server_virtual(tmp_path, lubm1):
+    write_dataset(str(tmp_path), 1, seed=42, fmt="npy")
+    ss = StringServer(str(tmp_path))
+    _, lay = lubm1
+    assert ss.str2id("<http://www.University0.edu>") == lay.univ_base
+    assert ss.str2id("__PREDICATE__") == 0
+    ub = "http://swat.cse.lehigh.edu/onto/univ-bench.owl#"
+    assert ss.str2id(f"<{ub}worksFor>") == P["worksFor"]
+    assert ss.id2str(T["Course"]) == f"<{ub}Course>"
+    assert ss.exist("<http://www.University0.edu>")
+    assert not ss.exist("<http://bogus>")
+
+
+def test_loader_roundtrip(tmp_path, lubm1):
+    from wukong_tpu.loader.base import load_dataset, load_triples
+
+    triples, _ = lubm1
+    write_dataset(str(tmp_path), 1, seed=42, fmt="npy")
+    loaded = load_triples(str(tmp_path))
+    assert np.array_equal(np.sort(loaded, axis=0), np.sort(triples, axis=0))
+    stores = load_dataset(str(tmp_path), 2)
+    assert len(stores) == 2
+    assert check_cross_partition(stores) == []

@@ -62,6 +62,10 @@ class GlobalConfig:
 
     def set(self, key: str, value: str, runtime: bool = False) -> None:
         """Set one key from its string form. runtime=True rejects immutable keys."""
+        self._apply(key, value, runtime)
+        self.finalize()
+
+    def _apply(self, key: str, value: str, runtime: bool) -> None:
         key = key.removeprefix("global_")
         valid = {f.name for f in fields(self) if f.init}
         if key not in valid:
@@ -75,10 +79,19 @@ class GlobalConfig:
             setattr(self, key, int(value))
         else:
             setattr(self, key, value.strip())
-        self.finalize()
 
     def load_str(self, text: str, runtime: bool = False) -> None:
-        """Parse 'key value' lines (comments with #) — config.hpp:152-181."""
+        """Parse 'key value' lines (comments with #) — config.hpp:152-181.
+
+        All items are parsed and validated before any is applied (the reference
+        builds a full item map first, config.hpp str2items), so a bad line
+        leaves the config untouched; unknown keys warn and are skipped
+        (config.hpp warns rather than aborting). Derived invariants are
+        recomputed once at the end, keeping clamps order-independent.
+        """
+        from wukong_tpu.utils.logger import log_warn
+
+        items: list[tuple[str, str]] = []
         for line in text.splitlines():
             line = line.split("#", 1)[0].strip()
             if not line:
@@ -86,7 +99,24 @@ class GlobalConfig:
             parts = line.split(None, 1)
             if len(parts) != 2:
                 raise ValueError(f"malformed config line: {line!r}")
-            self.set(parts[0], parts[1], runtime=runtime)
+            items.append((parts[0], parts[1]))
+        valid = {f.name for f in fields(self) if f.init}
+        known = [(k, v) for k, v in items if k.removeprefix("global_") in valid]
+        for k, v in items:
+            if k.removeprefix("global_") not in valid:
+                log_warn(f"unknown config item ignored: {k}")
+        # validate before applying (immutability + int parse)
+        for k, v in known:
+            key = k.removeprefix("global_")
+            if runtime and key in self._IMMUTABLE:
+                raise ValueError(f"config item '{key}' is immutable at runtime")
+            if isinstance(getattr(self, key), bool):
+                pass
+            elif isinstance(getattr(self, key), int):
+                int(v)  # raises ValueError on junk before anything is applied
+        for k, v in known:
+            self._apply(k, v, runtime)
+        self.finalize()
 
     def load_file(self, path: str, runtime: bool = False) -> None:
         with open(path) as f:

@@ -1,0 +1,149 @@
+"""NT -> ID-Triples converter (reference: datagen/generate_data.cpp).
+
+Reads a directory of N-Triples files, assigns ids with the reference's scheme
+(generate_data.cpp:112-123: __PREDICATE__=0, rdf:type=1, index ids from 2 in first-seen
+order, normal ids from 2^17 in first-seen order), detects typed-literal attribute
+triples (find_type, generate_data.cpp:53-64), honors ``@prefix`` lines
+(generate_data.cpp:144-149, 173-194), and writes ``id_<file>``/``attr_<file>`` plus
+``str_index``, ``str_normal`` and ``str_attr_index`` tables.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+RDF_TYPE_STR = "<http://www.w3.org/1999/02/22-rdf-syntax-ns#type>"
+
+_ATTR_SUFFIXES = [
+    ("^^xsd:int", 1), ("^^<http://www.w3.org/2001/XMLSchema#int>", 1),
+    ("^^xsd:float", 2), ("^^<http://www.w3.org/2001/XMLSchema#float>", 2),
+    ("^^xsd:double", 3), ("^^<http://www.w3.org/2001/XMLSchema#double>", 3),
+]
+
+
+def _find_type(obj: str) -> int:
+    for suf, t in _ATTR_SUFFIXES:
+        if suf in obj:
+            return t
+    return 0
+
+
+def _find_value(obj: str) -> str:
+    a = obj.find('"')
+    b = obj.find('"', a + 1)
+    if a < 0 or b < 0:
+        raise ValueError(f"malformed typed literal: {obj!r}")
+    return obj[a + 1:b]
+
+
+class IdAssigner:
+    def __init__(self):
+        from wukong_tpu.types import NORMAL_ID_START
+
+        self.str_to_id: dict[str, int] = {"__PREDICATE__": 0, RDF_TYPE_STR: 1}
+        self.index_str: list[str] = ["__PREDICATE__", RDF_TYPE_STR]
+        self.normal_str: list[str] = []
+        self.attr_index_str: list[str] = []
+        self.index_to_type: dict[str, int] = {}
+        self.next_index_id = 2
+        self.next_normal_id = NORMAL_ID_START
+
+    def normal(self, s: str) -> int:
+        i = self.str_to_id.get(s)
+        if i is None:
+            i = self.str_to_id[s] = self.next_normal_id
+            self.next_normal_id += 1
+            self.normal_str.append(s)
+        return i
+
+    def index(self, s: str, attr_type: int = 0) -> int:
+        i = self.str_to_id.get(s)
+        if i is None:
+            i = self.str_to_id[s] = self.next_index_id
+            self.next_index_id += 1
+            if attr_type:
+                self.attr_index_str.append(s)
+                self.index_to_type[s] = attr_type
+            else:
+                self.index_str.append(s)
+        return i
+
+
+def _expand_prefix(token: str, prefixes: dict[str, str]) -> str:
+    """prefix:name -> <full_uri_name> using @prefix map (generate_data.cpp:173-194)."""
+    if prefixes and not token.startswith("<") and ":" in token:
+        key, rest = token.split(":", 1)
+        if key in prefixes:
+            base = prefixes[key]
+            return base[:-1] + rest + ">"
+    return token
+
+
+def convert_dir(src_dir: str, dst_dir: str) -> dict:
+    os.makedirs(dst_dir, exist_ok=True)
+    ids = IdAssigner()
+    nfiles = 0
+    for name in sorted(os.listdir(src_dir)):
+        if name.startswith("."):
+            continue
+        nfiles += 1
+        prefixes: dict[str, str] = {}
+        with open(os.path.join(src_dir, name)) as fin, \
+                open(os.path.join(dst_dir, f"id_{name}"), "w") as fout, \
+                open(os.path.join(dst_dir, f"attr_{name}"), "w") as fattr:
+            for line in fin:
+                parts = line.split()
+                if len(parts) < 4:
+                    continue
+                subject, predicate, obj = parts[0], parts[1], " ".join(parts[2:-1])
+                if subject == "@prefix":
+                    prefixes[predicate.rstrip(":").split(":")[0]] = obj
+                    continue
+                t = _find_type(obj)
+                if t:
+                    sid = ids.normal(subject)
+                    pid = ids.index(predicate, attr_type=t)
+                    fattr.write(f"{sid}\t{pid}\t{t}\t{_find_value(obj)}\n")
+                    continue
+                subject = _expand_prefix(subject, prefixes)
+                predicate = _expand_prefix(predicate, prefixes)
+                obj = _expand_prefix(obj, prefixes)
+                sid = ids.normal(subject)
+                pid = ids.index(predicate)
+                oid = ids.index(obj) if predicate == RDF_TYPE_STR else ids.normal(obj)
+                fout.write(f"{sid}\t{pid}\t{oid}\n")
+
+    with open(os.path.join(dst_dir, "str_normal"), "w") as f:
+        for s in ids.normal_str:
+            f.write(f"{s}\t{ids.str_to_id[s]}\n")
+    with open(os.path.join(dst_dir, "str_index"), "w") as f:
+        for s in ids.index_str:
+            f.write(f"{s}\t{ids.str_to_id[s]}\n")
+    with open(os.path.join(dst_dir, "str_attr_index"), "w") as f:
+        for s in ids.attr_index_str:
+            f.write(f"{s}\t{ids.str_to_id[s]}\t{ids.index_to_type[s]}\n")
+
+    meta = {
+        "total_vertex": len(ids.str_to_id),
+        "normal_vertex": len(ids.normal_str),
+        "index_vertex": len(ids.index_str),
+        "attr_vertex": len(ids.attr_index_str),
+        "files": nfiles,
+    }
+    return meta
+
+
+def main(argv=None):
+    args = argv if argv is not None else sys.argv[1:]
+    if len(args) != 2:
+        print("usage: python -m wukong_tpu.loader.datagen <src_dir> <dst_dir>")
+        return 1
+    meta = convert_dir(args[0], args[1])
+    print(json.dumps(meta))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

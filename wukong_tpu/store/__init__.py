@@ -1,0 +1,3 @@
+from wukong_tpu.store.segment import CSRSegment  # noqa: F401
+from wukong_tpu.store.gstore import GStore, build_partition  # noqa: F401
+from wukong_tpu.store.string_server import StringServer  # noqa: F401

@@ -1,0 +1,207 @@
+"""Partitioned in-memory RDF graph store over CSR segments.
+
+Capability-equivalent to the reference's GStore/StaticGStore + DGraph facade
+(core/store/gstore.hpp, static_gstore.hpp, core/dgraph.hpp) with the storage
+format redesigned for TPU staging (see segment.py). Semantics preserved:
+
+- Partitioning: triple (s, p, o) lives on worker hash(s)%n as an OUT edge and on
+  worker hash(o)%n as an IN edge (base_loader.hpp:172-173) — every triple is
+  stored twice cluster-wide.
+- Type triples (p == TYPE_ID) have index-id objects; they produce the per-vertex
+  type list (v, TYPE_ID, OUT) on the subject owner and the *type index*
+  tidx[t] -> members on the subject owner (gstore.hpp:875-882 collect_idx_info —
+  built from OUT keys, hence subject-side). No (·, TYPE_ID, IN) normal segment
+  exists (static_gstore.hpp:127-130 skips type triples on the pos side).
+- Predicate indexes: pidx_in[p] = local subjects having p (from OUT keys),
+  pidx_out[p] = local objects under p (from IN keys) (gstore.hpp:858-888).
+- VERSATILE: per-vertex predicate lists (v, PREDICATE_ID, OUT/IN) — OUT includes
+  TYPE_ID (type triples are part of the pso walk, static_gstore.hpp:295-330),
+  IN excludes type triples (static_gstore.hpp:331-369); plus v/t/p sets
+  (all local entities / types / predicates, static_gstore.hpp:267-279).
+- Attributes: per-attr sorted (subject -> typed value) maps (gstore.hpp asv path).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from wukong_tpu.store.segment import CSRSegment
+from wukong_tpu.types import IN, NORMAL_ID_START, OUT, PREDICATE_ID, TYPE_ID
+from wukong_tpu.utils.mathutil import hash_mod
+
+
+@dataclass
+class AttrSegment:
+    keys: np.ndarray  # sorted subject ids
+    values: np.ndarray  # typed values (int64 or float64)
+    type: int  # AttrType tag
+
+    def lookup(self, vid: int):
+        i = np.searchsorted(self.keys, vid)
+        if i < len(self.keys) and self.keys[i] == vid:
+            return self.values[i], True
+        return None, False
+
+
+@dataclass
+class GStore:
+    """One worker's partition of the graph."""
+
+    sid: int
+    num_workers: int
+    # normal segments: (pid, dir) -> CSR; includes (TYPE_ID, OUT) = per-vertex types
+    segments: dict = field(default_factory=dict)
+    # index lists: (tpid, dir) -> sorted vid array
+    #   (pid, IN) = local subjects having pid; (pid, OUT) = local objects under pid
+    #   (tid, IN) = local members of type tid
+    index: dict = field(default_factory=dict)
+    # VERSATILE per-vertex predicate lists: dir -> CSR (key = vid, edges = pids)
+    vp: dict = field(default_factory=dict)
+    # VERSATILE singleton sets
+    v_set: np.ndarray = field(default_factory=lambda: np.empty(0, dtype=np.int64))
+    t_set: np.ndarray = field(default_factory=lambda: np.empty(0, dtype=np.int64))
+    p_set: np.ndarray = field(default_factory=lambda: np.empty(0, dtype=np.int64))
+    # attribute segments: aid -> AttrSegment
+    attrs: dict = field(default_factory=dict)
+    # which index ids are type ids (objects of rdf:type) vs predicates
+    type_ids: set = field(default_factory=set)
+
+    # ---- lookup API (mirrors core/dgraph.hpp:106-145) --------------------
+    def get_triples(self, vid: int, pid: int, d: int) -> np.ndarray:
+        """Neighbor list of a *local* vertex under a predicate.
+
+        pid == PREDICATE_ID returns the VERSATILE per-vertex predicate list
+        (gstore.hpp VERSATILE keys); pid == TYPE_ID with d == OUT returns the
+        vertex's types. (TYPE_ID, IN) is not a normal segment — engines must use
+        get_index for type membership (sparql.hpp:336-340).
+        """
+        if pid == PREDICATE_ID:
+            seg = self.vp.get(int(d))
+            return seg.lookup(vid) if seg is not None else np.empty(0, dtype=np.int64)
+        seg = self.segments.get((int(pid), int(d)))
+        return seg.lookup(vid) if seg is not None else np.empty(0, dtype=np.int64)
+
+    def get_index(self, tpid: int, d: int) -> np.ndarray:
+        """Index lookup: members of a type (d=IN) or subjects/objects of a predicate."""
+        if tpid == TYPE_ID and int(d) == IN:
+            return self.v_set  # all local entities (VERSATILE v_set)
+        if tpid == TYPE_ID and int(d) == OUT:
+            return self.t_set
+        if tpid == PREDICATE_ID and int(d) == OUT:
+            return self.p_set
+        return self.index.get((int(tpid), int(d)), np.empty(0, dtype=np.int64))
+
+    def get_attr(self, vid: int, aid: int, d: int = OUT):
+        seg = self.attrs.get(int(aid))
+        if seg is None:
+            return None, False
+        return seg.lookup(vid)
+
+    # ---- introspection ---------------------------------------------------
+    def memory_bytes(self) -> int:
+        n = sum(s.memory_bytes() for s in self.segments.values())
+        n += sum(a.nbytes for a in (self.v_set, self.t_set, self.p_set))
+        n += sum(s.memory_bytes() for s in self.vp.values())
+        n += sum(v.nbytes for v in self.index.values())
+        n += sum(a.keys.nbytes + a.values.nbytes for a in self.attrs.values())
+        return n
+
+    def stats_str(self) -> str:
+        ne = sum(s.num_edges for s in self.segments.values())
+        return (f"worker {self.sid}/{self.num_workers}: "
+                f"{len(self.segments)} segments, {ne} edges, "
+                f"{len(self.index)} index lists, {self.memory_bytes() / 2**20:.1f} MiB")
+
+
+def owner_of_subject(s: np.ndarray, n: int) -> np.ndarray:
+    return hash_mod(s, n)
+
+
+def _pred_runs(p_sorted: np.ndarray, k_sorted: np.ndarray, v_sorted: np.ndarray):
+    """Yield (pid, keys, values) slices per predicate run of presorted arrays."""
+    if len(p_sorted) == 0:
+        return
+    upids, starts = np.unique(p_sorted, return_index=True)
+    bounds = np.append(starts, len(p_sorted))
+    for i, pid in enumerate(upids):
+        sl = slice(bounds[i], bounds[i + 1])
+        yield int(pid), k_sorted[sl], v_sorted[sl]
+
+
+def build_partition(triples: np.ndarray, sid: int, num_workers: int,
+                    attr_triples=None, versatile: bool = True) -> GStore:
+    """Build worker `sid`'s GStore from the full [M,3] triple array.
+
+    The reference reaches the same state via the loader's RDMA shuffle + sorted
+    insert (base_loader.hpp:165-219, static_gstore.hpp:383-454); here partition
+    selection + CSR building are vectorized numpy over the shared array.
+    """
+    g = GStore(sid=sid, num_workers=num_workers)
+    s, p, o = triples[:, 0], triples[:, 1], triples[:, 2]
+    mine_out = hash_mod(s, num_workers) == sid  # pso copy (subject owner)
+    mine_in = hash_mod(o, num_workers) == sid  # pos copy (object owner)
+
+    so, po, oo = s[mine_out], p[mine_out], o[mine_out]
+    si, pi, oi = s[mine_in], p[mine_in], o[mine_in]
+    # object side never stores type triples as normal edges
+    norm_in = oi >= NORMAL_ID_START
+    si, pi, oi = si[norm_in], pi[norm_in], oi[norm_in]
+
+    # ---- normal segments + predicate indexes (one sort per side) ---------
+    # pso order: (p, s, o) — each predicate run becomes one OUT segment
+    order = np.lexsort((oo, so, po))
+    so, po, oo = so[order], po[order], oo[order]
+    for pid, ks, vs in _pred_runs(po, so, oo):
+        g.segments[(pid, OUT)] = CSRSegment.from_sorted_pairs(ks, vs)
+        if pid != TYPE_ID:
+            g.index[(pid, IN)] = g.segments[(pid, OUT)].keys.copy()
+    # pos order: (p, o, s) — each predicate run becomes one IN segment
+    order = np.lexsort((si, oi, pi))
+    si, pi, oi = si[order], pi[order], oi[order]
+    for pid, ks, vs in _pred_runs(pi, oi, si):
+        g.segments[(pid, IN)] = CSRSegment.from_sorted_pairs(ks, vs)
+        g.index[(pid, OUT)] = g.segments[(pid, IN)].keys.copy()
+
+    # ---- type index: t -> local members (subject-side) -------------------
+    tseg = g.segments.get((TYPE_ID, OUT))
+    if tseg is not None:
+        ts = np.repeat(tseg.keys, np.diff(tseg.offsets))
+        to = tseg.edges
+        order = np.argsort(to, kind="stable")
+        ts, to = ts[order], to[order]
+        for t, ks, vs in _pred_runs(to, ts, ts):
+            g.index[(t, IN)] = np.unique(ks)
+            g.type_ids.add(t)
+
+    # ---- VERSATILE -------------------------------------------------------
+    if versatile:
+        g.vp[OUT] = CSRSegment.from_pairs(so, po)  # includes TYPE_ID edges
+        g.vp[IN] = CSRSegment.from_pairs(oi, pi)
+        g.v_set = np.unique(np.concatenate([so, oi]))
+        g.t_set = (np.unique(tseg.edges) if tseg is not None
+                   else np.empty(0, dtype=np.int64))
+        g.p_set = np.unique(np.concatenate([po[po != TYPE_ID], pi]))
+
+    # ---- attributes ------------------------------------------------------
+    if attr_triples:
+        by_aid: dict[int, list] = {}
+        for (asub, aid, at, av) in attr_triples:
+            if hash_mod(asub, num_workers) == sid:
+                by_aid.setdefault(int(aid), []).append((asub, at, av))
+        for aid, rows in by_aid.items():
+            rows.sort()
+            keys = np.asarray([r[0] for r in rows], dtype=np.int64)
+            at = rows[0][1]
+            dtype = np.float64 if at in (2, 3) else np.int64
+            vals = np.asarray([r[2] for r in rows], dtype=dtype)
+            g.attrs[aid] = AttrSegment(keys=keys, values=vals, type=at)
+
+    return g
+
+
+def build_all_partitions(triples: np.ndarray, num_workers: int,
+                         attr_triples=None, versatile: bool = True) -> list[GStore]:
+    return [build_partition(triples, i, num_workers, attr_triples, versatile)
+            for i in range(num_workers)]

@@ -93,8 +93,9 @@ def is_idx_id(sid: int) -> bool:
 
 
 def is_tpid(ssid: int) -> bool:
-    """'type or predicate id' — positive and inside the index id space."""
-    return 0 < ssid < NORMAL_ID_START or ssid == PREDICATE_ID
+    """'type or predicate id': inside the index space, excluding the reserved
+    PREDICATE_ID/TYPE_ID slots (core/store/vertex.hpp:41: id > 1 && id < 2^17)."""
+    return 1 < ssid < NORMAL_ID_START
 
 
 # ---------------------------------------------------------------------------
