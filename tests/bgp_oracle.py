@@ -1,0 +1,67 @@
+"""Independent BGP evaluator used as a golden oracle in tests.
+
+Evaluates basic graph patterns by naive index-nested-loop join directly over
+the raw triple array — a completely different algorithm/code path from the
+engine under test. Variables are negative ints, constants positive.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+
+import numpy as np
+
+
+class TripleIndex:
+    def __init__(self, triples: np.ndarray):
+        self.by_s = defaultdict(list)
+        self.by_o = defaultdict(list)
+        self.by_p = defaultdict(list)
+        for s, p, o in triples.tolist():
+            self.by_s[s].append((p, o))
+            self.by_o[o].append((p, s))
+            self.by_p[p].append((s, o))
+
+
+def eval_bgp(index: TripleIndex, patterns, required_vars):
+    """patterns: (s, p, o) triples as written (vars < 0). Returns list of
+    projected tuples (with multiplicity)."""
+    bindings = [dict()]
+    for (ps, pp, po) in patterns:
+        new = []
+        for b in bindings:
+            s = b.get(ps, ps) if ps < 0 else ps
+            p = b.get(pp, pp) if pp < 0 else pp
+            o = b.get(po, po) if po < 0 else po
+            s_res, p_res, o_res = s >= 0, p >= 0, o >= 0
+            if s_res:
+                cands = [(s, pc, oc) for (pc, oc) in index.by_s.get(s, [])]
+            elif o_res:
+                cands = [(sc, pc, o) for (pc, sc) in index.by_o.get(o, [])]
+            elif p_res:
+                cands = [(sc, p, oc) for (sc, oc) in index.by_p.get(p, [])]
+            else:
+                cands = [(sc, pc, oc) for pc, so in index.by_p.items()
+                         for (sc, oc) in so]
+            for (cs, cp, co) in cands:
+                if s_res and cs != s:
+                    continue
+                if p_res and cp != p:
+                    continue
+                if o_res and co != o:
+                    continue
+                nb = dict(b)
+                if not s_res:
+                    nb[ps] = cs
+                if not p_res:
+                    nb[pp] = cp
+                if not o_res:
+                    nb[po] = co
+                # consistency when one var appears twice in the pattern
+                if (ps == pp and nb.get(ps) != nb.get(pp)) or \
+                   (ps == po and nb.get(ps) != nb.get(po)) or \
+                   (pp == po and nb.get(pp) != nb.get(po)):
+                    continue
+                new.append(nb)
+        bindings = new
+    return [tuple(b[v] for v in required_vars) for b in bindings]

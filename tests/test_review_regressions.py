@@ -39,3 +39,30 @@ def test_virtual_strings_out_of_range_email():
 def test_is_tpid_excludes_reserved():
     assert not is_tpid(0) and not is_tpid(1)
     assert is_tpid(2) and not is_tpid(1 << 17)
+
+
+def test_empty_segment_vectorized_paths():
+    import numpy as np
+
+    from wukong_tpu.store.segment import CSRSegment
+
+    seg = CSRSegment.empty()
+    _, deg = seg.lookup_many(np.array([1, 2]))
+    assert deg.tolist() == [0, 0]
+    assert seg.contains_pair(np.array([1]), np.array([2])).tolist() == [False]
+
+
+def test_datagen_prefix_attr_entity_not_split(tmp_path):
+    from wukong_tpu.loader.datagen import convert_dir
+
+    src = tmp_path / "nt"
+    src.mkdir()
+    (src / "f.nt").write_text(
+        "@prefix ex: <http://ex.org/> .\n"
+        "ex:a <http://ex.org/p> ex:b .\n"
+        'ex:a <http://ex.org/age> "40"^^xsd:int .\n')
+    convert_dir(str(src), str(tmp_path / "id"))
+    norm = (tmp_path / "id" / "str_normal").read_text()
+    lines = [l for l in norm.splitlines() if l]
+    assert len(lines) == 2  # <http://ex.org/a> and <http://ex.org/b>, no ex:a
+    assert all(l.startswith("<http://ex.org/") for l in lines)

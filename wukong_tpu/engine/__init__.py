@@ -1,0 +1,1 @@
+from wukong_tpu.engine.cpu import CPUEngine  # noqa: F401

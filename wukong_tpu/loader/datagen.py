@@ -101,14 +101,18 @@ def convert_dir(src_dir: str, dst_dir: str) -> dict:
                 if subject == "@prefix":
                     prefixes[predicate.rstrip(":").split(":")[0]] = obj
                     continue
+                # expand prefixes before id assignment on BOTH branches (the
+                # reference expands only on the normal branch,
+                # generate_data.cpp:171-194, which splits a prefixed subject
+                # into two ids when it also has attribute triples — fixed here)
+                subject = _expand_prefix(subject, prefixes)
+                predicate = _expand_prefix(predicate, prefixes)
                 t = _find_type(obj)
                 if t:
                     sid = ids.normal(subject)
                     pid = ids.index(predicate, attr_type=t)
                     fattr.write(f"{sid}\t{pid}\t{t}\t{_find_value(obj)}\n")
                     continue
-                subject = _expand_prefix(subject, prefixes)
-                predicate = _expand_prefix(predicate, prefixes)
                 obj = _expand_prefix(obj, prefixes)
                 sid = ids.normal(subject)
                 pid = ids.index(predicate)

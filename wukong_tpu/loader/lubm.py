@@ -410,15 +410,6 @@ class VirtualLubmStrings:
         self.lay = lubm_layout(self.counts)
         self._index_s2i = {s: i for s, i in index_strings()}
         self._index_i2s = {i: s for s, i in index_strings()}
-        # dept-local entity bases in block order, for id->str classification
-        lay = self.lay
-        self._class_bases = [
-            ("Department", lay.dept_id), ("Faculty", lay.fac_base),
-            ("Course", lay.course_base), ("GraduateCourse", lay.gcourse_base),
-            ("UndergraduateStudent", lay.ug_base), ("GraduateStudent", lay.gs_base),
-            ("ResearchGroup", lay.rg_base), ("Publication", lay.pub_base),
-            ("Email", lay.email_base),
-        ]
 
     # -- helpers -----------------------------------------------------------
     def _dept_univ_local(self, d: int) -> tuple[int, int]:
@@ -550,6 +541,13 @@ class VirtualLubmStrings:
             self.str2id(s)
             return True
         except KeyError:
+            return False
+
+    def exist_id(self, i: int) -> bool:
+        try:
+            self.id2str(i)
+            return True
+        except (KeyError, IndexError):
             return False
 
 

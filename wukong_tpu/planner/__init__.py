@@ -1,0 +1,2 @@
+from wukong_tpu.planner.plan_file import set_plan  # noqa: F401
+from wukong_tpu.planner.heuristic import heuristic_plan  # noqa: F401

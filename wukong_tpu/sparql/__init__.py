@@ -1,0 +1,10 @@
+from wukong_tpu.sparql.ir import (  # noqa: F401
+    Filter,
+    FilterType,
+    Order,
+    Pattern,
+    PatternGroup,
+    SPARQLQuery,
+    SPARQLTemplate,
+)
+from wukong_tpu.sparql.parser import Parser, SPARQLSyntaxError  # noqa: F401

@@ -11,6 +11,7 @@ import numpy as np
 
 from wukong_tpu.store.gstore import GStore
 from wukong_tpu.types import IN, NORMAL_ID_START, OUT, PREDICATE_ID, TYPE_ID
+from wukong_tpu.utils.mathutil import hash_mod
 
 
 def check_partition(g: GStore, index_check: bool = True,
@@ -94,8 +95,9 @@ def check_cross_partition(stores: list[GStore]) -> list[str]:
             o = seg.edges
             norm = o >= NORMAL_ID_START
             s, o = s[norm], o[norm]
+            owners = hash_mod(o, n)
             for dst in range(n):
-                m = o % n == dst
+                m = owners == dst
                 if not m.any():
                     continue
                 rseg = stores[dst].segments.get((pid, IN))

@@ -70,6 +70,9 @@ class CSRSegment:
 
     def lookup_many(self, vids: np.ndarray):
         """Vectorized lookup: returns (start, degree) per query vid (0 deg if absent)."""
+        if len(self.keys) == 0:
+            z = np.zeros(len(vids), dtype=np.int64)
+            return z, z.copy()
         idx = np.searchsorted(self.keys, vids)
         idx_c = np.clip(idx, 0, max(len(self.keys) - 1, 0))
         found = (len(self.keys) > 0) & (idx < len(self.keys))

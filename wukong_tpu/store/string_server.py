@@ -83,3 +83,10 @@ class StringServer:
             return True
         except KeyError:
             return False
+
+    def exist_id(self, i: int) -> bool:
+        try:
+            self.id2str(i)
+            return True
+        except (KeyError, IndexError):
+            return False
