@@ -66,3 +66,91 @@ def test_datagen_prefix_attr_entity_not_split(tmp_path):
     lines = [l for l in norm.splitlines() if l]
     assert len(lines) == 2  # <http://ex.org/a> and <http://ex.org/b>, no ex:a
     assert all(l.startswith("<http://ex.org/") for l in lines)
+
+
+def _lubm1_world():
+    from wukong_tpu.engine.cpu import CPUEngine
+    from wukong_tpu.loader.lubm import VirtualLubmStrings, generate_lubm
+    from wukong_tpu.store.gstore import build_partition
+
+    triples, _ = generate_lubm(1, seed=42)
+    g = build_partition(triples, 0, 1)
+    ss = VirtualLubmStrings(1, seed=42)
+    return g, ss, CPUEngine(g, ss)
+
+
+UB = "http://swat.cse.lehigh.edu/onto/univ-bench.owl#"
+
+
+def test_heuristic_pred_var_const_subject_known_object():
+    from wukong_tpu.planner.heuristic import heuristic_plan
+    from wukong_tpu.sparql.parser import Parser
+
+    g, ss, eng = _lubm1_world()
+    d0 = "<http://www.Department0.University0.edu>"
+    text = f"""SELECT ?u ?p WHERE {{
+        {d0} <{UB}subOrganizationOf> ?u .
+        {d0} ?p ?u . }}"""
+    q = Parser(ss).parse(text)
+    heuristic_plan(q)
+    eng.execute(q)
+    assert q.result.status_code == 0
+    assert q.result.nrows == 1  # (University0, subOrganizationOf)
+
+
+def test_plan_file_order_validation():
+    from wukong_tpu.planner.plan_file import set_plan
+    from wukong_tpu.sparql.parser import Parser
+
+    _, ss, _ = _lubm1_world()
+    q = Parser(ss).parse(
+        f"SELECT ?x WHERE {{ ?x <{UB}subOrganizationOf> <http://www.University0.edu> . }}")
+    assert not set_plan(q.pattern_group, "0 >\n")
+    assert not set_plan(q.pattern_group, "5 >\n")
+
+
+def test_filter_bound_and_order_by_unbound_var():
+    from wukong_tpu.sparql.parser import Parser
+    from wukong_tpu.planner.heuristic import heuristic_plan
+    from wukong_tpu.utils.errors import ErrorCode
+
+    g, ss, eng = _lubm1_world()
+    q = Parser(ss).parse(
+        f"SELECT ?d WHERE {{ ?d <{UB}subOrganizationOf> <http://www.University0.edu> . "
+        "FILTER(bound(?zz)) }")
+    heuristic_plan(q)
+    eng.execute(q)
+    assert q.result.status_code == 0 and q.result.nrows == 0
+    q2 = Parser(ss).parse(
+        f"SELECT ?d WHERE {{ ?d <{UB}subOrganizationOf> <http://www.University0.edu> . }}"
+        " ORDER BY ?zz")
+    heuristic_plan(q2)
+    eng.execute(q2)
+    assert q2.result.status_code == ErrorCode.VERTEX_INVALID
+
+
+def test_template_in_union_rejected():
+    import pytest
+
+    from wukong_tpu.sparql.parser import Parser, SPARQLSyntaxError
+
+    _, ss, _ = _lubm1_world()
+    text = f"""SELECT ?x WHERE {{
+        {{ ?x <{UB}takesCourse> %<{UB}GraduateCourse> . }}
+        UNION {{ ?x <{UB}takesCourse> %<{UB}Course> . }} }}"""
+    with pytest.raises(SPARQLSyntaxError):
+        Parser(ss).parse_template(text.replace(f"%<{UB}", "%ub:").replace(">", ">", 1))
+
+
+def test_template_in_union_rejected_pname():
+    import pytest
+
+    from wukong_tpu.sparql.parser import Parser, SPARQLSyntaxError
+
+    _, ss, _ = _lubm1_world()
+    text = f"""PREFIX ub: <{UB}>
+    SELECT ?x WHERE {{
+        {{ ?x ub:takesCourse %ub:GraduateCourse . }}
+        UNION {{ ?x ub:takesCourse %ub:Course . }} }}"""
+    with pytest.raises(SPARQLSyntaxError):
+        Parser(ss).parse_template(text)

@@ -578,7 +578,10 @@ class CPUEngine:
             self._relational_filter(f, res, keep)
         elif f.type == FilterType.Builtin_bound:
             col = res.var2col(f.arg1.valueArg)
-            keep &= res.table[:, col] != BLANK_ID
+            if col == NO_RESULT:
+                keep &= False  # a never-bound variable is unbound on every row
+            else:
+                keep &= res.table[:, col] != BLANK_ID
         elif f.type == FilterType.Builtin_isiri:
             self._str_match_filter(f, res, keep, lambda s: s.startswith("<"))
         elif f.type == FilterType.Builtin_isliteral:
@@ -667,6 +670,8 @@ class CPUEngine:
                 keys = []
                 for o in reversed(q.orders):
                     col = res.var2col(o.id)
+                    assert_ec(col != NO_RESULT, ErrorCode.VERTEX_INVALID,
+                              "ORDER BY references an unbound variable")
                     vals = table[:, col]
                     uniq = np.unique(vals)
                     m = {int(u): (self.str_server.id2str(int(u))

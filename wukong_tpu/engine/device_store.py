@@ -1,0 +1,221 @@
+"""Device-resident CSR segment store — the GPUCache analogue.
+
+The reference stages gstore segments into GPU HBM with block-mapping tables and
+pattern-aware eviction (core/gpu/gpu_cache.hpp). On TPU the natural unit is the
+whole CSR segment as dense arrays; XLA needs static shapes, so arrays are padded
+to power-of-two length classes (bounding kernel recompiles) and cached by
+(pid, dir). A byte budget with LRU eviction plays the role of the reference's
+block free lists; queries pin the segments of their remaining patterns
+(gpu_cache.hpp conflict-aware eviction) via `pin`/`unpin`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from wukong_tpu.types import IN, TYPE_ID
+
+INT32_MAX = np.iinfo(np.int32).max
+
+
+def _next_pow2(n: int) -> int:
+    return 1 if n <= 1 else 1 << (n - 1).bit_length()
+
+
+BUCKET = 8  # 8-way associative buckets (matching the reference's cluster size,
+#             gstore.hpp ASSOCIATIVITY) — one bucket row = one contiguous 32B load
+
+
+@dataclass
+class DeviceSegment:
+    """One (pid, dir) CSR segment staged on device, keyed by an 8-way bucketized
+    hash table (the reference probes 8-slot cluster-chaining buckets for the
+    same locality reason — gstore.hpp:55-120, gpu_hash.cu:149-260; binary
+    search over sorted keys lowers to a slow ~21-round scan loop on TPU, and
+    random-gather rounds dominate, so the design minimizes probe rounds and
+    keeps each probe a row-contiguous gather)."""
+
+    bkey: object  # jnp int32 [NB, 8] bucket keys; empty = -1
+    bstart: object  # jnp int32 [NB, 8] edge range start
+    bdeg: object  # jnp int32 [NB, 8] edge range length
+    edges: object  # jnp int32 [E_pad], padded with INT32_MAX
+    num_keys: int
+    num_edges: int
+    max_probe: int  # static probe-round bound — part of the jit key
+    max_deg_log2: int  # static binary-search depth for membership tests
+
+    @property
+    def nbytes(self) -> int:
+        return (self.bkey.size + self.bstart.size
+                + self.bdeg.size + self.edges.size) * 4
+
+
+_HASH_MULT = np.uint32(2654435761)  # Knuth multiplicative hashing
+
+
+def build_hash_table(keys: np.ndarray, offsets: np.ndarray):
+    """Host-side bucketized table build (vectorized placement rounds).
+
+    Returns (bkey [NB,8], bstart, bdeg, max_probe). Bucket count is sized for
+    <=50% load so nearly all keys land in their home bucket (max_probe 1-2).
+    """
+    K = len(keys)
+    NB = max(_next_pow2((K + BUCKET // 2 - 1) // (BUCKET // 2)), 2)
+    bmask = np.uint32(NB - 1)
+    bkey = np.full((NB, BUCKET), -1, dtype=np.int32)
+    bstart = np.zeros((NB, BUCKET), dtype=np.int32)
+    bdeg = np.zeros((NB, BUCKET), dtype=np.int32)
+    if K == 0:
+        return bkey, bstart, bdeg, 1
+    starts = offsets[:-1].astype(np.int64)
+    degs = (offsets[1:] - offsets[:-1]).astype(np.int64)
+    hb = (keys.astype(np.uint32) * _HASH_MULT) & bmask
+    used = np.zeros(NB, dtype=np.int64)
+    pending = np.arange(K)
+    round_ = 0
+    while len(pending):
+        tb = ((hb[pending] + np.uint32(round_)) & bmask).astype(np.int64)
+        order = np.argsort(tb, kind="stable")
+        tbs = tb[order]
+        # rank within each same-bucket group this round
+        idx = np.arange(len(tbs))
+        begins = np.flatnonzero(np.concatenate([[True], tbs[1:] != tbs[:-1]]))
+        group_id = np.cumsum(np.concatenate([[0], (tbs[1:] != tbs[:-1]).astype(int)]))
+        rank = idx - begins[group_id]
+        lane = used[tbs] + rank
+        ok = lane < BUCKET
+        rows = tbs[ok]
+        lanes = lane[ok]
+        kidx = pending[order[ok]]
+        bkey[rows, lanes] = keys[kidx]
+        bstart[rows, lanes] = starts[kidx]
+        bdeg[rows, lanes] = degs[kidx]
+        np.add.at(used, rows, 1)
+        placed = np.zeros(len(pending), dtype=bool)
+        placed[order[ok]] = True
+        pending = pending[~placed]
+        round_ += 1
+        if round_ > NB:
+            raise RuntimeError("bucket hash build failed to converge")
+    return bkey, bstart, bdeg, max(round_, 1)
+
+
+class DeviceStore:
+    """Stages host CSR segments into device memory on demand."""
+
+    def __init__(self, gstore, budget_bytes: int | None = None, device=None):
+        import jax
+
+        self.g = gstore
+        self.device = device or jax.devices()[0]
+        self.budget = budget_bytes
+        self._cache: dict = {}  # (pid, dir) -> DeviceSegment
+        self._index_cache: dict = {}  # (tpid, dir) -> (jnp array, real_len)
+        self._lru: list = []
+        self._pinned: set = set()
+        self.bytes_used = 0
+
+    # ---- segment staging -------------------------------------------------
+    def segment(self, pid: int, d: int) -> DeviceSegment | None:
+        """Stage (pid, dir) segment; TYPE_ID IN resolves to the type index CSR."""
+        key = (int(pid), int(d))
+        if key in self._cache:
+            self._touch(key)
+            return self._cache[key]
+        if pid == TYPE_ID and int(d) == IN:
+            seg = self._build_type_index_csr()
+        else:
+            host = self.g.segments.get(key)
+            if host is None:
+                return None
+            seg = self._stage(host.keys, host.offsets, host.edges)
+        if seg is not None:
+            self._insert(key, seg)
+        return seg
+
+    def index_list(self, tpid: int, d: int):
+        """Index edge list (type members / pred subjects-objects) on device."""
+        key = (int(tpid), int(d))
+        if key in self._index_cache:
+            return self._index_cache[key]
+        import jax.numpy as jnp
+
+        arr = np.asarray(self.g.get_index(tpid, d), dtype=np.int32)
+        pad = _next_pow2(len(arr))
+        padded = np.full(pad, INT32_MAX, dtype=np.int32)
+        padded[: len(arr)] = arr
+        dev = jnp.asarray(padded)
+        self._index_cache[key] = (dev, len(arr))
+        self.bytes_used += dev.size * 4
+        return self._index_cache[key]
+
+    def _build_type_index_csr(self) -> DeviceSegment | None:
+        """Type membership as one CSR keyed by type id (subject-side tidx)."""
+        pairs = [(t, self.g.index[(t, IN)]) for t in sorted(self.g.type_ids)]
+        if not pairs:
+            return None
+        keys = np.asarray([t for t, _ in pairs], dtype=np.int64)
+        counts = np.asarray([len(v) for _, v in pairs], dtype=np.int64)
+        offsets = np.zeros(len(keys) + 1, dtype=np.int64)
+        np.cumsum(counts, out=offsets[1:])
+        edges = np.concatenate([v for _, v in pairs]) if pairs else np.empty(0)
+        return self._stage(keys, offsets, edges)
+
+    def _stage(self, keys, offsets, edges) -> DeviceSegment:
+        import jax
+        import jax.numpy as jnp
+
+        K, E = len(keys), len(edges)
+        Ep = _next_pow2(E)
+        e = np.full(Ep, INT32_MAX, dtype=np.int32)
+        e[:E] = edges
+        bkey, bstart, bdeg, max_probe = build_hash_table(
+            np.asarray(keys), np.asarray(offsets))
+        max_deg = int((offsets[1:] - offsets[:-1]).max()) if K else 1
+        seg = DeviceSegment(
+            bkey=jax.device_put(jnp.asarray(bkey), self.device),
+            bstart=jax.device_put(jnp.asarray(bstart), self.device),
+            bdeg=jax.device_put(jnp.asarray(bdeg), self.device),
+            edges=jax.device_put(jnp.asarray(e), self.device),
+            num_keys=K, num_edges=E, max_probe=max_probe,
+            max_deg_log2=max(int(max_deg).bit_length(), 1),
+        )
+        return seg
+
+    # ---- cache management ------------------------------------------------
+    def _insert(self, key, seg: DeviceSegment) -> None:
+        self._cache[key] = seg
+        self._lru.append(key)
+        self.bytes_used += seg.nbytes
+        if self.budget is not None:
+            while self.bytes_used > self.budget and self._evictable():
+                victim = self._evictable()[0]
+                self._evict(victim)
+
+    def _evictable(self):
+        return [k for k in self._lru if k not in self._pinned and k in self._cache]
+
+    def _evict(self, key) -> None:
+        seg = self._cache.pop(key)
+        self._lru.remove(key)
+        self.bytes_used -= seg.nbytes
+
+    def _touch(self, key) -> None:
+        if key in self._lru:
+            self._lru.remove(key)
+            self._lru.append(key)
+
+    def pin(self, keys) -> None:
+        self._pinned.update((int(p), int(d)) for (p, d) in keys)
+
+    def unpin(self, keys) -> None:
+        for k in keys:
+            self._pinned.discard((int(k[0]), int(k[1])))
+
+    def prefetch(self, patterns) -> None:
+        """Stage the segments of upcoming pattern steps (async via dispatch)."""
+        for p in patterns:
+            if p.predicate >= 0:
+                self.segment(p.predicate, p.direction)

@@ -89,10 +89,14 @@ def _plan_group(pg: PatternGroup) -> None:
                               "disconnected pattern group")
         remaining.remove(best)
         # anchor on a KNOWN var side: prefer subject if it's a known var,
-        # else a const subject with known object stays as written (const_to_known)
+        # else a const subject with known object stays as written
+        # (const_to_known). Variable-predicate patterns have no const-anchored
+        # kernel mid-plan (no [CONST|UNKNOWN|KNOWN] kernel, sparql.hpp:981-983),
+        # so they must anchor on the known VARIABLE side.
         s_var_known = best.subject < 0 and best.subject in known
-        s_const = best.subject > 0
-        if s_var_known or s_const:
+        pred_is_var = best.predicate < 0
+        s_const_ok = best.subject > 0 and not pred_is_var
+        if s_var_known or s_const_ok:
             oriented = Pattern(best.subject, best.predicate, OUT, best.object,
                                best.pred_type)
         else:

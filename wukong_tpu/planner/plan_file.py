@@ -55,6 +55,11 @@ def _set_plan_group(group: PatternGroup, lines, ptypes_pos) -> bool:
     if len(orders) < len(group.patterns):
         log_error("wrong format file content (fewer plan lines than patterns)")
         return False
+    bad = [o for o in orders if not (1 <= o <= len(group.patterns))]
+    if bad:
+        log_error(f"plan pattern numbers out of range: {bad} "
+                  f"(query has {len(group.patterns)} patterns)")
+        return False
     _set_direction(group, orders, dirs, ptypes_pos)
     return True
 

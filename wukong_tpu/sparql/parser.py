@@ -407,9 +407,12 @@ class Parser:
             at = self.str_server.pid2type.get(sid, int(AttrType.SID_t))
         return sid, at
 
-    def _resolve_group(self, group: dict) -> PatternGroup:
+    def _resolve_group(self, group: dict, top_level: bool = True) -> PatternGroup:
         pg = PatternGroup()
         for (s, p, o) in group["patterns"]:
+            if not top_level and (s.kind == "template" or o.kind == "template"):
+                raise SPARQLSyntaxError(
+                    "%placeholders are only supported in the top-level group")
             ssid, _ = self._resolve_term(s, False) if s.kind != "template" \
                 else (self._reserve_template_slot(len(pg.patterns), "subject", s), 0)
             pid, ptype = self._resolve_term(p, True)
@@ -419,9 +422,9 @@ class Parser:
             pat.pred_type = ptype
             pg.patterns.append(pat)
         for sub in group["unions"]:
-            pg.unions.append(self._resolve_group(sub))
+            pg.unions.append(self._resolve_group(sub, top_level=False))
         for sub in group["optional"]:
-            spg = self._resolve_group(sub)
+            spg = self._resolve_group(sub, top_level=False)
             pg.optional.append(spg)
         for f in group["filters"]:
             pg.filters.append(f)

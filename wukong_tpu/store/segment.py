@@ -74,10 +74,8 @@ class CSRSegment:
             z = np.zeros(len(vids), dtype=np.int64)
             return z, z.copy()
         idx = np.searchsorted(self.keys, vids)
-        idx_c = np.clip(idx, 0, max(len(self.keys) - 1, 0))
-        found = (len(self.keys) > 0) & (idx < len(self.keys))
-        if len(self.keys):
-            found &= self.keys[idx_c] == vids
+        idx_c = np.clip(idx, 0, len(self.keys) - 1)
+        found = (idx < len(self.keys)) & (self.keys[idx_c] == vids)
         start = np.where(found, self.offsets[idx_c], 0)
         deg = np.where(found, self.offsets[idx_c + 1] - self.offsets[idx_c], 0)
         return start, deg
