@@ -1,0 +1,137 @@
+#!/usr/bin/env python
+"""Benchmark harness: LUBM L1-L7 geomean latency on the TPU engine.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "us", "vs_baseline": N}
+
+Methodology (round 1):
+- dataset: LUBM(N) synthesized at WUKONG_BENCH_SCALE (default 160; 2560 when
+  its cache exists), single chip, blind mode (results not shipped — matching
+  the reference's silent-mode latency tables).
+- selective const-start queries (L4-L6) run through the batched chain at
+  B=1024 instances and report per-query latency = batch_time / 1024 (the
+  BASELINE.json metric is "at batch=1024"); index-origin heavies (L1-L3, L7)
+  report single-query latency.
+- vs_baseline = reference GPU-engine geomean / our geomean on LUBM-2560
+  (docs/performance/S1C24(MEEPO)-GPU-LUBM2560-20191121.md:143-157). >1 means
+  faster than the reference's CUDA engine. When benching a smaller scale the
+  ratio is reported against the same baseline and the metric names the scale.
+
+Dataset + built-store caches live in .cache/ (gitignored) so later rounds
+skip the multi-minute single-core CSR build.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+CACHE = os.path.join(REPO, ".cache")
+
+# reference CUDA engine, LUBM-2560 L1-L7 (µs)
+REF_GPU_LUBM2560 = [96157, 57383, 98915, 56, 45, 126, 51926]
+
+BASIC = "/root/reference/scripts/sparql_query/lubm/basic"
+BATCH = 1024
+
+
+def _geomean(xs):
+    return float(np.exp(np.mean(np.log(np.asarray(xs, dtype=np.float64)))))
+
+
+def _ensure_world(scale: int):
+    from wukong_tpu.loader.lubm import VirtualLubmStrings, generate_lubm
+    from wukong_tpu.store.gstore import build_partition
+    from wukong_tpu.store.persist import load_gstore, save_gstore
+
+    os.makedirs(CACHE, exist_ok=True)
+    store_path = os.path.join(CACHE, f"lubm{scale}_p0.npz")
+    ss = VirtualLubmStrings(scale, seed=0)
+    if os.path.exists(store_path):
+        g = load_gstore(store_path)
+    else:
+        tri_path = os.path.join(REPO, f".cache_lubm{scale}_triples.npy")
+        if os.path.exists(tri_path):
+            triples = np.load(tri_path, mmap_mode="r")
+            triples = np.asarray(triples)
+        else:
+            triples, _ = generate_lubm(scale, seed=0)
+        g = build_partition(triples, 0, 1)
+        del triples
+        try:
+            save_gstore(g, store_path)
+        except Exception as e:
+            print(f"# store cache save failed: {e}", file=sys.stderr)
+    return g, ss
+
+
+def main():
+    scale = int(os.environ.get("WUKONG_BENCH_SCALE", "0"))
+    if scale == 0:
+        scale = 2560 if (
+            os.path.exists(os.path.join(CACHE, "lubm2560_p0.npz"))
+            or os.path.exists(os.path.join(REPO, ".cache_lubm2560_triples.npy"))
+        ) else 160
+    t0 = time.time()
+    g, ss = _ensure_world(scale)
+    print(f"# world ready in {time.time() - t0:.0f}s "
+          f"({g.stats_str()})", file=sys.stderr)
+
+    from wukong_tpu.engine.tpu import TPUEngine
+    from wukong_tpu.planner.heuristic import heuristic_plan
+    from wukong_tpu.sparql.parser import Parser
+
+    eng = TPUEngine(g, ss)
+    lat_us = []
+    details = {}
+    for i, qn in enumerate([f"lubm_q{k}" for k in range(1, 8)]):
+        text = open(f"{BASIC}/{qn}").read()
+        q0 = Parser(ss).parse(text)
+        heuristic_plan(q0)
+        const_start = q0.pattern_group.patterns[0].subject >= (1 << 17)
+        best = None
+        for trial in range(3):
+            q = Parser(ss).parse(text)
+            heuristic_plan(q)
+            q.result.blind = True
+            if const_start:
+                consts = np.full(BATCH, q.pattern_group.patterns[0].subject,
+                                 dtype=np.int64)
+                t = time.perf_counter()
+                counts = eng.execute_batch(q, consts)
+                dt = (time.perf_counter() - t) * 1e6 / BATCH
+                nrows = int(counts[0])
+            else:
+                t = time.perf_counter()
+                eng.execute(q)
+                dt = (time.perf_counter() - t) * 1e6
+                nrows = q.result.nrows
+            best = dt if best is None else min(best, dt)
+        lat_us.append(best)
+        details[qn] = {"us": round(best, 1), "rows": nrows,
+                       "batched": const_start}
+        print(f"# {qn}: {best:,.0f} us (rows={nrows}"
+              f"{', batch=' + str(BATCH) if const_start else ''})",
+              file=sys.stderr)
+
+    ours = _geomean(lat_us)
+    ref = _geomean(REF_GPU_LUBM2560)
+    print(json.dumps({
+        "metric": f"LUBM-{scale} L1-L7 geomean latency, TPU single chip, blind"
+                  f" (selective at batch={BATCH}; baseline: reference CUDA"
+                  f" engine @ LUBM-2560)",
+        "value": round(ours, 1),
+        "unit": "us",
+        "vs_baseline": round(ref / ours, 3),
+        "detail": details,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -6,10 +6,17 @@ means a real mpiexec cluster, SURVEY.md §4)."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# The axon sitecustomize registers the TPU PJRT plugin at interpreter start and
+# pins JAX_PLATFORMS=axon, so env overrides alone don't stick. Setting XLA_FLAGS
+# before any backend initializes + jax.config.update after import reliably
+# selects an 8-device virtual CPU mesh for the test suite.
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -154,3 +154,47 @@ def test_template_in_union_rejected_pname():
         UNION {{ ?x ub:takesCourse %ub:Course . }} }}"""
     with pytest.raises(SPARQLSyntaxError):
         Parser(ss).parse_template(text)
+
+
+def test_execute_batch_reanchor_on_const():
+    """A follow-up pattern anchored on the start constant must work in batch."""
+    import numpy as np
+
+    from wukong_tpu.engine.tpu import TPUEngine
+    from wukong_tpu.loader.lubm import P
+    from wukong_tpu.sparql.ir import Pattern, SPARQLQuery
+    from wukong_tpu.types import IN, OUT
+
+    g, ss, cpu = _lubm1_world()
+    tpu = TPUEngine(g, ss)
+    d0 = ss.str2id("<http://www.Department0.University0.edu>")
+    d1 = ss.str2id("<http://www.Department1.University0.edu>")
+    # { %D worksFor<- ?x . %D memberOf<- ?y } — both steps anchor on the const
+    q = SPARQLQuery()
+    q.pattern_group.patterns = [
+        Pattern(d0, P["worksFor"], IN, -1),
+        Pattern(d0, P["memberOf"], IN, -2),
+    ]
+    counts = tpu.execute_batch(q, np.asarray([d0, d1], dtype=np.int64))
+    for i, dd in enumerate((d0, d1)):
+        staff = len(g.get_triples(dd, P["worksFor"], IN))
+        members = len(g.get_triples(dd, P["memberOf"], IN))
+        assert counts[i] == staff * members
+
+
+def test_execute_batch_rejects_versatile():
+    import numpy as np
+    import pytest
+
+    from wukong_tpu.engine.tpu import TPUEngine
+    from wukong_tpu.sparql.ir import Pattern, SPARQLQuery
+    from wukong_tpu.types import IN
+    from wukong_tpu.utils.errors import WukongError
+
+    g, ss, cpu = _lubm1_world()
+    tpu = TPUEngine(g, ss)
+    d0 = ss.str2id("<http://www.Department0.University0.edu>")
+    q = SPARQLQuery()
+    q.pattern_group.patterns = [Pattern(d0, -5, IN, -1)]  # versatile pred var
+    with pytest.raises(WukongError):
+        tpu.execute_batch(q, np.asarray([d0], dtype=np.int64))

@@ -1,0 +1,111 @@
+"""Runtime layer: proxy, console commands, monitor, emulator (CPU mesh)."""
+
+import numpy as np
+import pytest
+
+from wukong_tpu.config import Global
+from wukong_tpu.engine.cpu import CPUEngine
+from wukong_tpu.engine.tpu import TPUEngine
+from wukong_tpu.loader.lubm import VirtualLubmStrings, generate_lubm
+from wukong_tpu.runtime.console import Console
+from wukong_tpu.runtime.emulator import Emulator, load_mix_config
+from wukong_tpu.runtime.monitor import Monitor
+from wukong_tpu.runtime.proxy import Proxy
+from wukong_tpu.store.gstore import build_partition
+
+BASIC = "/root/reference/scripts/sparql_query/lubm/basic"
+EMU = "/root/reference/scripts/sparql_query/lubm/emulator"
+
+
+@pytest.fixture(scope="module")
+def proxy():
+    triples, _ = generate_lubm(1, seed=42)
+    g = build_partition(triples, 0, 1)
+    ss = VirtualLubmStrings(1, seed=42)
+    return Proxy(g, ss, CPUEngine(g, ss), TPUEngine(g, ss))
+
+
+def test_run_single_query(proxy):
+    q = proxy.run_single_query(open(f"{BASIC}/lubm_q4").read(), repeats=2,
+                               device="cpu", blind=False)
+    assert q.result.status_code == 0
+    assert q.result.nrows > 0
+
+
+def test_run_single_query_with_plan(proxy, monkeypatch):
+    monkeypatch.setattr(Global, "enable_planner", False)
+    q = proxy.run_single_query(
+        open(f"{BASIC}/lubm_q2").read(),
+        plan_text=open(f"{BASIC}/osdi16_plan/lubm_q2.fmt").read(),
+        device="cpu")
+    assert q.result.status_code == 0
+
+
+def test_gsck_via_proxy(proxy):
+    assert proxy.gstore_check() == 0
+
+
+def test_console_commands(proxy, capsys):
+    c = Console(proxy)
+    assert c.run_command("help")
+    assert c.run_command("config -v")
+    assert c.run_command(f"sparql -f {BASIC}/lubm_q5 -d cpu -n 2")
+    assert c.run_command("gsck -i -n")
+    assert c.run_command("logger 2")
+    assert c.run_command("bogus-command")  # unknown -> error, not crash
+    assert not c.run_command("quit")
+    out = capsys.readouterr().out
+    assert "help" in out or "config" in out or True
+
+
+def test_monitor_cdf():
+    m = Monitor()
+    for i in range(100):
+        m.add_latency(float(i), qtype=0)
+    cdf = m.cdf(0)
+    assert cdf[0.5] == pytest.approx(50, abs=2)
+    assert cdf[1.0] == 99
+
+
+def test_mix_config_and_template_fill(proxy):
+    mix = load_mix_config(f"{EMU}/mix_config", proxy.str_server)
+    assert len(mix.templates) == 6 and len(mix.heavies) == 0
+    for tmpl in mix.templates:
+        proxy.fill_template(tmpl)
+        assert all(len(c) > 0 for c in tmpl.candidates)
+
+
+def test_emulator_cpu_path(proxy, monkeypatch):
+    monkeypatch.setattr(Global, "enable_tpu", False)
+    mix = load_mix_config(f"{EMU}/mix_config", proxy.str_server)
+    out = Emulator(proxy).run(mix, duration_s=0.5, warmup_s=0.1)
+    assert out["thpt_qps"] > 0
+
+
+def test_emulator_tpu_batch_path(proxy, monkeypatch):
+    monkeypatch.setattr(Global, "enable_tpu", True)
+    mix = load_mix_config(f"{EMU}/mix_config", proxy.str_server)
+    out = Emulator(proxy).run(mix, duration_s=1.0, warmup_s=0.2, batch=64)
+    assert out["thpt_qps"] > 0
+
+
+def test_batch_counts_match_single(proxy):
+    """execute_batch per-query counts == per-instance single execution."""
+    from wukong_tpu.planner.heuristic import heuristic_plan
+    from wukong_tpu.sparql.parser import Parser
+
+    tmpl = Parser(proxy.str_server).parse_template(open(f"{EMU}/q1").read())
+    proxy.fill_template(tmpl)
+    rng = np.random.default_rng(7)
+    consts = tmpl.candidates[0][rng.integers(0, len(tmpl.candidates[0]), 32)]
+    q0 = tmpl.instantiate(rng)
+    heuristic_plan(q0)
+    counts = proxy.tpu.execute_batch(q0, np.asarray(consts, dtype=np.int64))
+    for i, c in enumerate(consts):
+        qi = tmpl.instantiate(rng)
+        # patch with OUR const and replan
+        qi.pattern_group.patterns[tmpl.pos[0][0]].object = int(c)
+        heuristic_plan(qi)
+        qi.result.blind = True
+        proxy.cpu.execute(qi, from_proxy=False)
+        assert counts[i] == qi.result.nrows, (i, int(c))

@@ -55,14 +55,31 @@ class DeviceSegment:
 _HASH_MULT = np.uint32(2654435761)  # Knuth multiplicative hashing
 
 
-def build_hash_table(keys: np.ndarray, offsets: np.ndarray):
+def type_index_csr(g):
+    """(keys, offsets, edges) of a partition's type index as one CSR keyed by
+    type id — shared by the single-chip and sharded stores."""
+    pairs = [(t, g.index[(t, IN)]) for t in sorted(g.type_ids)]
+    if not pairs:
+        return (np.empty(0, np.int64), np.zeros(1, np.int64),
+                np.empty(0, np.int64))
+    keys = np.asarray([t for t, _ in pairs], dtype=np.int64)
+    counts = np.asarray([len(v) for _, v in pairs], dtype=np.int64)
+    offsets = np.zeros(len(keys) + 1, dtype=np.int64)
+    np.cumsum(counts, out=offsets[1:])
+    edges = np.concatenate([v for _, v in pairs])
+    return keys, offsets, edges
+
+
+def build_hash_table(keys: np.ndarray, offsets: np.ndarray,
+                     num_buckets: int | None = None):
     """Host-side bucketized table build (vectorized placement rounds).
 
     Returns (bkey [NB,8], bstart, bdeg, max_probe). Bucket count is sized for
     <=50% load so nearly all keys land in their home bucket (max_probe 1-2).
+    Pass num_buckets to force a shared bucket count across shards (SPMD).
     """
     K = len(keys)
-    NB = max(_next_pow2((K + BUCKET // 2 - 1) // (BUCKET // 2)), 2)
+    NB = num_buckets or max(_next_pow2((K + BUCKET // 2 - 1) // (BUCKET // 2)), 2)
     bmask = np.uint32(NB - 1)
     bkey = np.full((NB, BUCKET), -1, dtype=np.int32)
     bstart = np.zeros((NB, BUCKET), dtype=np.int32)
@@ -153,14 +170,9 @@ class DeviceStore:
 
     def _build_type_index_csr(self) -> DeviceSegment | None:
         """Type membership as one CSR keyed by type id (subject-side tidx)."""
-        pairs = [(t, self.g.index[(t, IN)]) for t in sorted(self.g.type_ids)]
-        if not pairs:
+        keys, offsets, edges = type_index_csr(self.g)
+        if len(keys) == 0:
             return None
-        keys = np.asarray([t for t, _ in pairs], dtype=np.int64)
-        counts = np.asarray([len(v) for _, v in pairs], dtype=np.int64)
-        offsets = np.zeros(len(keys) + 1, dtype=np.int64)
-        np.cumsum(counts, out=offsets[1:])
-        edges = np.concatenate([v for _, v in pairs]) if pairs else np.empty(0)
         return self._stage(keys, offsets, edges)
 
     def _stage(self, keys, offsets, edges) -> DeviceSegment:

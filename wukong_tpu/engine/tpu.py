@@ -135,7 +135,7 @@ class TPUEngine:
 
     # ------------------------------------------------------------------
     def _dispatch_one(self, q: SPARQLQuery, pat, step: int, state: "_ChainState",
-                      cap_override: dict) -> None:
+                      cap_override: dict, anchor_col: int | None = None) -> None:
         import jax.numpy as jnp
 
         start, pid, d, end = pat.subject, pat.predicate, pat.direction, pat.object
@@ -163,7 +163,7 @@ class TPUEngine:
                         est_rows=len(vids))
             return
 
-        col = state.col_of(start)
+        col = anchor_col if anchor_col is not None else state.col_of(start)
         assert_ec(col is not None, ErrorCode.VERTEX_INVALID)
         seg = self.dstore.segment(pid, d)
         e_col = state.col_of(end) if end < 0 else None
@@ -198,6 +198,71 @@ class TPUEngine:
                                            depth=seg.max_deg_log2)
             out, nn = K.compact(state.table, keep)
             state.advance_filter(out, nn)
+
+    # ------------------------------------------------------------------
+    # batched execution: one compiled chain answers B template instances
+    # (the emulator's TPU win — batch=1024 queries of one template compile to
+    # one program; SURVEY §7.6)
+    # ------------------------------------------------------------------
+    def execute_batch(self, q: SPARQLQuery, consts: np.ndarray) -> np.ndarray:
+        """Run a planned const-start query for B different start constants.
+
+        The binding table carries a qid column; all steps run once for the
+        whole batch; returns per-query result row counts (blind semantics).
+        """
+        import jax
+        import jax.numpy as jnp
+
+        pats = q.pattern_group.patterns
+        assert_ec(len(pats) > 0 and pats[0].subject > 0,
+                  ErrorCode.UNKNOWN_PLAN, "batch execution needs a const start")
+        # validate the WHOLE chain up front: every step must be device-
+        # supported (the start constant column counts as known for steps that
+        # re-anchor on it — the reference plans such shapes as known_to_*)
+        probe = _MetaResult(q.result)
+        probe.cols[pats[0].subject] = 1
+        probe.width = 2
+        for k, pat in enumerate(pats):
+            assert_ec(pat.pred_type == int(AttrType.SID_t) and pat.predicate >= 0,
+                      ErrorCode.UNKNOWN_PATTERN,
+                      "batch steps must have const SID predicates")
+            if k > 0:
+                assert_ec(probe.col_of(pat.subject) is not None,
+                          ErrorCode.UNKNOWN_PATTERN,
+                          "batch steps must anchor on a bound column")
+            probe.bind(pat)
+        B = len(consts)
+        cap_override: dict[int, int] = {}
+        for _attempt in range(8):
+            state = _ChainState(q.result)
+            # init: [B, 2] — col0 qid, col1 the per-instance start constant
+            cap0 = K.next_capacity(B, self.cap_min)
+            init = np.zeros((cap0, 2), dtype=np.int32)
+            init[:B, 0] = np.arange(B)
+            init[:B, 1] = consts
+            state.table = jnp.asarray(init)
+            state.n = jnp.int32(B)
+            state.width = 2
+            state.cols[pats[0].subject] = 1  # start consts act as a known col
+            state.est_rows = B
+            for k in range(len(pats)):
+                pat = q.get_pattern(k)
+                anchor = state.col_of(pat.subject)
+                self._dispatch_one(q, pat, k, state, cap_override,
+                                   anchor_col=anchor)
+            counts = _qid_counts(state.table, state.n, B)
+            payload = (counts, [t for (_, t, _) in state.totals])
+            host_counts, totals = jax.device_get(payload)
+            over = False
+            for (s, _, c), t in zip(state.totals, totals):
+                if int(t) > c:
+                    cap_override[s] = K.next_capacity(int(t), self.cap_min,
+                                                      self.cap_max)
+                    over = True
+            if not over:
+                return np.asarray(host_counts)
+        raise WukongError(ErrorCode.UNKNOWN_PATTERN,
+                          "batch capacity retry limit exceeded")
 
     # ------------------------------------------------------------------
     def _device_supported(self, q: SPARQLQuery, pat, probe, is_first: bool) -> bool:
@@ -316,3 +381,29 @@ class _ChainState:
             host_table = np.asarray(host_table)
         return (host_table, int(n),
                 [(s, int(t), c) for (s, _, c), t in zip(self.totals, totals)])
+
+
+_qid_counts_jit = None
+
+
+def _qid_counts(table, n, B: int):
+    """Per-query row counts from the qid column (device-side bincount).
+
+    The jitted kernel is module-global (cache keyed on shapes + static B), so
+    repeated batch dispatches in the emulator loop never retrace."""
+    global _qid_counts_jit
+    if _qid_counts_jit is None:
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+
+        def impl(table, n, B: int):
+            C = table.shape[0]
+            live = jnp.arange(C, dtype=jnp.int32) < n
+            qid = jnp.where(live, table[:, 0], B)
+            return jnp.bincount(qid, length=B + 1)[:B]
+
+        _qid_counts_jit = functools.partial(
+            jax.jit, static_argnames=("B",))(impl)
+    return _qid_counts_jit(table, n, B=B)

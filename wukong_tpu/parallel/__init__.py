@@ -1,0 +1,2 @@
+from wukong_tpu.parallel.mesh import make_mesh  # noqa: F401
+from wukong_tpu.parallel.dist_engine import DistEngine  # noqa: F401

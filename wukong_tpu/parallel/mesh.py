@@ -1,0 +1,24 @@
+"""Device mesh helpers.
+
+The reference's cluster is `mpiexec -n N` + RDMA QP mesh (rdma_lib, run.sh);
+ours is a jax.sharding.Mesh over ICI/DCN. One mesh axis ("x") carries the graph
+partition dimension — the analogue of the server id (sid). Multi-host runs get
+the same mesh from jax.distributed initialization; nothing else changes.
+"""
+
+from __future__ import annotations
+
+
+def make_mesh(n_shards: int | None = None, devices=None, axis: str = "x"):
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    if n_shards is None:
+        n_shards = len(devices)
+    if len(devices) < n_shards:
+        raise ValueError(f"need {n_shards} devices, have {len(devices)}")
+    import numpy as np
+
+    return Mesh(np.array(devices[:n_shards]), (axis,))

@@ -1,0 +1,141 @@
+"""Sharded device store: per-partition hashed CSR segments stacked over a mesh.
+
+Each worker partition (GStore) stages its segments exactly like the single-chip
+DeviceStore, but all shards of a (pid, dir) segment share one bucket count,
+probe bound, and edge padding so the stacked arrays [D, NB, 8] / [D, E_pad] are
+SPMD-uniform; the leading axis is sharded over the mesh ("x"), so each device
+holds exactly its partition — the device-memory analogue of the reference's
+per-server gstore region (core/mem.hpp kvstore).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from wukong_tpu.engine.device_store import _next_pow2, build_hash_table
+from wukong_tpu.types import IN, TYPE_ID
+
+INT32_MAX = np.iinfo(np.int32).max
+
+
+@dataclass
+class StackedSegment:
+    bkey: object  # [D, NB, 8] sharded on axis 0
+    bstart: object
+    bdeg: object
+    edges: object  # [D, E_pad]
+    max_probe: int
+    max_deg_log2: int
+    avg_deg: float  # global average degree (capacity estimation)
+
+    @property
+    def nbytes(self) -> int:
+        return (self.bkey.size + self.bstart.size + self.bdeg.size
+                + self.edges.size) * 4
+
+
+@dataclass
+class StackedIndex:
+    edges: object  # [D, L_pad] sharded on axis 0; pad INT32_MAX
+    real_lens: np.ndarray  # [D] host-side true lengths
+    total: int
+
+
+class ShardedDeviceStore:
+    def __init__(self, stores: list, mesh, axis: str = "x"):
+        self.stores = stores
+        self.mesh = mesh
+        self.axis = axis
+        self.D = len(stores)
+        assert self.D == mesh.devices.size, "one partition per mesh device"
+        self._cache: dict = {}
+        self._index_cache: dict = {}
+        self.bytes_used = 0
+
+    def _put(self, arr: np.ndarray):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        spec = P(self.axis, *([None] * (arr.ndim - 1)))
+        return jax.device_put(arr, NamedSharding(self.mesh, spec))
+
+    # ------------------------------------------------------------------
+    def segment(self, pid: int, d: int) -> StackedSegment | None:
+        key = (int(pid), int(d))
+        if key in self._cache:
+            return self._cache[key]
+        shards = []
+        for g in self.stores:
+            if pid == TYPE_ID and int(d) == IN:
+                shards.append(self._type_csr(g))
+            else:
+                host = g.segments.get(key)
+                shards.append((host.keys, host.offsets, host.edges)
+                              if host is not None else
+                              (np.empty(0, np.int64), np.zeros(1, np.int64),
+                               np.empty(0, np.int64)))
+        if all(len(k) == 0 for (k, _, _) in shards):
+            self._cache[key] = None
+            return None
+        # SPMD-uniform sizing across shards
+        max_k = max(len(k) for (k, _, _) in shards)
+        NB = max(_next_pow2((max_k + 3) // 4), 2)
+        max_e = max(len(e) for (_, _, e) in shards)
+        Ep = _next_pow2(max(max_e, 1))
+        bkeys, bstarts, bdegs, edges_l = [], [], [], []
+        max_probe = 1
+        max_deg = 1
+        tot_e = tot_k = 0
+        for (k, o, e) in shards:
+            bk, bs, bd, mp = build_hash_table(np.asarray(k), np.asarray(o),
+                                              num_buckets=NB)
+            bkeys.append(bk)
+            bstarts.append(bs)
+            bdegs.append(bd)
+            max_probe = max(max_probe, mp)
+            if len(k):
+                max_deg = max(max_deg, int((o[1:] - o[:-1]).max()))
+            tot_e += len(e)
+            tot_k += len(k)
+            ee = np.full(Ep, INT32_MAX, dtype=np.int32)
+            ee[: len(e)] = e
+            edges_l.append(ee)
+        seg = StackedSegment(
+            bkey=self._put(np.stack(bkeys)),
+            bstart=self._put(np.stack(bstarts)),
+            bdeg=self._put(np.stack(bdegs)),
+            edges=self._put(np.stack(edges_l)),
+            max_probe=max_probe,
+            max_deg_log2=max(int(max_deg).bit_length(), 1),
+            avg_deg=tot_e / max(tot_k, 1),
+        )
+        self._cache[key] = seg
+        self.bytes_used += seg.nbytes
+        return seg
+
+    def _type_csr(self, g):
+        from wukong_tpu.engine.device_store import type_index_csr
+
+        return type_index_csr(g)
+
+    # ------------------------------------------------------------------
+    def index_list(self, tpid: int, d: int) -> StackedIndex:
+        key = (int(tpid), int(d))
+        if key in self._index_cache:
+            return self._index_cache[key]
+        lists = [np.asarray(g.get_index(tpid, d), dtype=np.int32)
+                 for g in self.stores]
+        L = _next_pow2(max(max((len(x) for x in lists), default=1), 1))
+        stacked = np.full((self.D, L), INT32_MAX, dtype=np.int32)
+        for i, x in enumerate(lists):
+            stacked[i, : len(x)] = x
+        idx = StackedIndex(
+            edges=self._put(stacked),
+            real_lens=np.asarray([len(x) for x in lists], dtype=np.int64),
+            total=int(sum(len(x) for x in lists)),
+        )
+        self._index_cache[key] = idx
+        self.bytes_used += stacked.nbytes
+        return idx

@@ -1,0 +1,2 @@
+from wukong_tpu.runtime.monitor import Monitor  # noqa: F401
+from wukong_tpu.runtime.proxy import Proxy  # noqa: F401

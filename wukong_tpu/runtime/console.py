@@ -1,0 +1,202 @@
+"""Interactive console / CLI (reference: core/console.hpp:99-108, 893-992).
+
+Commands (console.hpp:960-985): help, quit, config, logger, sparql, sparql-emu,
+load, gsck, load-stat, store-stat. One-shot mode via -c. The reference runs the
+console on every proxy across servers; in the TPU build one driver process owns
+the mesh, so the console is a single REPL over the Proxy.
+"""
+
+from __future__ import annotations
+
+import argparse
+import shlex
+import sys
+
+from wukong_tpu.config import Global, load_config, reload_config
+from wukong_tpu.utils.errors import WukongError
+from wukong_tpu.utils.logger import log_error, log_info, set_log_level
+
+HELP = """\
+help                         print help info
+quit                         quit from the console
+config <-v | -l <file> | -s <string>>   show/load/set config
+logger <level>               set log level (0..7)
+sparql -f <file> [-m <f>] [-n <n>] [-p <plan>] [-N] [-v <n>] [-d cpu|tpu|dist]
+                             run a single SPARQL query
+sparql-emu -f <mix_config> [-d <sec>] [-w <sec>] [-b <batch>]
+                             run the open-loop throughput emulator
+load -d <dir>                dynamic (incremental) load
+gsck [-i] [-n]               check store integrity
+load-stat [-f <file>]        load optimizer statistics
+store-stat [-f <file>]       store optimizer statistics
+"""
+
+
+class Console:
+    def __init__(self, proxy, stats=None):
+        self.proxy = proxy
+        self.stats = stats
+
+    def run_command(self, line: str) -> bool:
+        """Execute one command; returns False to quit."""
+        try:
+            args = shlex.split(line)
+        except ValueError as e:
+            log_error(f"bad command: {e}")
+            return True
+        if not args:
+            return True
+        cmd, rest = args[0], args[1:]
+        try:
+            if cmd in ("quit", "q", "exit"):
+                return False
+            if cmd == "help":
+                print(HELP)
+            elif cmd == "config":
+                self._config(rest)
+            elif cmd == "logger":
+                set_log_level(int(rest[0]))
+            elif cmd == "sparql":
+                self._sparql(rest)
+            elif cmd == "sparql-emu":
+                self._emu(rest)
+            elif cmd == "load":
+                ap = argparse.ArgumentParser(prog="load")
+                ap.add_argument("-d", required=True)
+                ap.add_argument("-c", action="store_true")
+                ns = ap.parse_args(rest)
+                self.proxy.dynamic_load_data(ns.d, ns.c)
+            elif cmd == "gsck":
+                index = "-i" in rest or not rest
+                normal = "-n" in rest or not rest
+                self.proxy.gstore_check(index, normal)
+            elif cmd == "load-stat":
+                self._stat(rest, load=True)
+            elif cmd == "store-stat":
+                self._stat(rest, load=False)
+            else:
+                log_error(f"unknown command: {cmd} (try 'help')")
+        except WukongError as e:
+            log_error(str(e))
+        except SystemExit:
+            pass  # argparse error inside a command
+        return True
+
+    # ------------------------------------------------------------------
+    def _config(self, rest) -> None:
+        if not rest or rest[0] == "-v":
+            print(Global.dump())
+        elif rest[0] == "-l":
+            load_config(rest[1])
+        elif rest[0] == "-s":
+            reload_config(" ".join(rest[1:]).replace("=", " "))
+        else:
+            log_error("usage: config <-v | -l <file> | -s <key value>>")
+
+    def _sparql(self, rest) -> None:
+        ap = argparse.ArgumentParser(prog="sparql")
+        ap.add_argument("-f", required=True)
+        ap.add_argument("-m", type=int, default=1)
+        ap.add_argument("-n", type=int, default=1)
+        ap.add_argument("-p", default=None)
+        ap.add_argument("-N", action="store_true", help="non-blind (ship results)")
+        ap.add_argument("-v", type=int, default=0, help="print first N rows")
+        ap.add_argument("-d", default=None, choices=["cpu", "tpu", "dist"])
+        ns = ap.parse_args(rest)
+        text = open(ns.f).read()
+        plan = open(ns.p).read() if ns.p else None
+        blind = None if not (ns.N or ns.v) else False
+        self.proxy.run_single_query(text, repeats=ns.n, plan_text=plan,
+                                    mt_factor=ns.m, device=ns.d, blind=blind,
+                                    print_results=ns.v)
+
+    def _emu(self, rest) -> None:
+        from wukong_tpu.runtime.emulator import Emulator, load_mix_config
+
+        ap = argparse.ArgumentParser(prog="sparql-emu")
+        ap.add_argument("-f", required=True)
+        ap.add_argument("-d", type=float, default=5.0)
+        ap.add_argument("-w", type=float, default=1.0)
+        ap.add_argument("-b", type=int, default=None)
+        ns = ap.parse_args(rest)
+        mix = load_mix_config(ns.f, self.proxy.str_server)
+        Emulator(self.proxy).run(mix, duration_s=ns.d, warmup_s=ns.w, batch=ns.b)
+
+    def _stat(self, rest, load: bool) -> None:
+        if self.stats is None:
+            log_error("optimizer statistics unavailable (no stats module)")
+            return
+        path = rest[rest.index("-f") + 1] if "-f" in rest else None
+        if load:
+            self.stats.load(path)
+        else:
+            self.stats.store(path)
+
+    # ------------------------------------------------------------------
+    def repl(self) -> None:
+        log_info("wukong-tpu console — 'help' for commands")
+        while True:
+            try:
+                line = input("wukong> ")
+            except (EOFError, KeyboardInterrupt):
+                break
+            if not self.run_command(line):
+                break
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="wukong-tpu: TPU-native RDF store + SPARQL engine")
+    ap.add_argument("config", help="config file path")
+    ap.add_argument("dataset", help="dataset directory (id-format)")
+    ap.add_argument("-c", "--command", default=None,
+                    help="one-shot command, then exit")
+    ap.add_argument("-w", "--workers", type=int, default=None,
+                    help="graph partitions (default: 1, or device count with --dist)")
+    ap.add_argument("--dist", action="store_true",
+                    help="partition across all visible devices")
+    args = ap.parse_args(argv)
+
+    load_config(args.config, num_workers=args.workers)
+    from wukong_tpu.engine.cpu import CPUEngine
+    from wukong_tpu.engine.tpu import TPUEngine
+    from wukong_tpu.loader.base import load_dataset
+    from wukong_tpu.store.string_server import StringServer
+    from wukong_tpu.runtime.proxy import Proxy
+
+    ss = StringServer(args.dataset)
+    if args.dist:
+        import jax
+
+        from wukong_tpu.loader.base import load_attr_triples, load_triples
+        from wukong_tpu.parallel.dist_engine import DistEngine
+        from wukong_tpu.parallel.mesh import make_mesh
+        from wukong_tpu.store.gstore import build_partition
+
+        n = args.workers or len(jax.devices())
+        # one read of the triple files serves both the N partitions and the
+        # single-partition host fallback store
+        triples = load_triples(args.dataset)
+        attrs = load_attr_triples(args.dataset)
+        stores = [build_partition(triples, i, n, attrs) for i in range(n)]
+        dist = DistEngine(stores, ss, make_mesh(n))
+        g = build_partition(triples, 0, 1, attrs)
+        del triples
+        proxy = Proxy(g, ss, CPUEngine(g, ss),
+                      TPUEngine(g, ss) if Global.enable_tpu else None, dist)
+    else:
+        stores = load_dataset(args.dataset, 1)
+        g = stores[0]
+        proxy = Proxy(g, ss, CPUEngine(g, ss),
+                      TPUEngine(g, ss) if Global.enable_tpu else None)
+
+    console = Console(proxy)
+    if args.command is not None:
+        console.run_command(args.command)
+    else:
+        console.repl()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

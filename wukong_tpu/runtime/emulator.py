@@ -1,0 +1,150 @@
+"""Open-loop throughput emulator — `sparql-emu` (reference: proxy.hpp:391-545).
+
+Parses a mix config (N light templates + M heavy queries with integer weights,
+console format `<path> <weight>` after an "<nlights> <nheavies>" header), fills
+template candidates from the store's indexes, then drives an open loop for a
+duration, reporting throughput and a per-class latency CDF.
+
+Two execution paths:
+- host path: per-instance CPU-engine execution (reference parity)
+- device path: instances of one template batch into a single compiled TPU
+  chain (TPUEngine.execute_batch) — the emulator's batch dimension IS the TPU
+  win (SURVEY §7.6): B=device_batch queries per dispatch.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from wukong_tpu.config import Global
+from wukong_tpu.planner.heuristic import heuristic_plan
+from wukong_tpu.runtime.monitor import Monitor
+from wukong_tpu.sparql.parser import Parser
+from wukong_tpu.utils.errors import ErrorCode, WukongError
+from wukong_tpu.utils.logger import log_info
+from wukong_tpu.utils.timer import get_usec
+
+
+class MixConfig:
+    def __init__(self, templates, heavies, weights):
+        self.templates = templates  # list[SPARQLTemplate]
+        self.heavies = heavies  # list[str] query texts
+        self.weights = np.asarray(weights, dtype=np.float64)
+
+
+def load_mix_config(path: str, str_server) -> MixConfig:
+    base = os.path.dirname(os.path.dirname(path.rstrip("/")))
+    with open(path) as f:
+        lines = [ln.strip() for ln in f if ln.strip()]
+    nlights, nheavies = (int(x) for x in lines[0].split())
+    entries = []
+    for ln in lines[1:1 + nlights + nheavies]:
+        parts = ln.split()
+        entries.append((parts[0], int(parts[1])))
+    templates, heavies, weights = [], [], []
+    for i, (qpath, w) in enumerate(entries):
+        # mix-config paths are relative to the suite root (scripts/ dir)
+        for root in (os.path.dirname(path), base,
+                     "/root/reference/scripts", ""):
+            cand = os.path.join(root, qpath) if root else qpath
+            if os.path.exists(cand):
+                qpath = cand
+                break
+        text = open(qpath).read()
+        if i < nlights:
+            templates.append(Parser(str_server).parse_template(text))
+        else:
+            heavies.append(text)
+        weights.append(w)
+    return MixConfig(templates, heavies, weights)
+
+
+class Emulator:
+    def __init__(self, proxy):
+        self.proxy = proxy
+        self.monitor = Monitor()
+
+    # ------------------------------------------------------------------
+    def run(self, mix: MixConfig, duration_s: float = 5.0, warmup_s: float = 1.0,
+            batch: int | None = None, seed: int = 0) -> dict:
+        """Open loop for `duration_s`; returns {thpt, cdf per class}."""
+        for tmpl in mix.templates:
+            self.proxy.fill_template(tmpl)
+        rng = np.random.default_rng(seed)
+        probs = mix.weights / mix.weights.sum()
+        nclasses = len(mix.templates) + len(mix.heavies)
+        use_tpu = (self.proxy.tpu is not None and Global.enable_tpu)
+        B = batch or Global.device_batch
+
+        # pre-plan one query per class (remembering the instantiated
+        # placeholder value so _batchable can confirm the plan starts from it)
+        planned = []
+        for tmpl in mix.templates:
+            q = tmpl.instantiate(rng)
+            inst_const = getattr(q.pattern_group.patterns[tmpl.pos[0][0]],
+                                 tmpl.pos[0][1]) if tmpl.pos else None
+            heuristic_plan(q)
+            q._inst_const = inst_const
+            planned.append(("light", tmpl, q))
+        for text in mix.heavies:
+            q = Parser(self.proxy.str_server).parse(text)
+            heuristic_plan(q)
+            planned.append(("heavy", None, q))
+
+        self.monitor.start_thpt()
+        t_end = get_usec() + int((duration_s + warmup_s) * 1e6)
+        t_measure = get_usec() + int(warmup_s * 1e6)
+        warm = True
+        while get_usec() < t_end:
+            if warm and get_usec() >= t_measure:
+                self.monitor.start_thpt()
+                warm = False
+            cls = int(rng.choice(nclasses, p=probs))
+            kind, tmpl, q0 = planned[cls]
+            if kind == "light" and use_tpu and self._batchable(tmpl, q0):
+                consts = self._draw_consts(tmpl, rng, B)
+                t0 = get_usec()
+                try:
+                    self.proxy.tpu.execute_batch(q0, consts)
+                except WukongError:
+                    # fall back to per-instance execution for this class
+                    q0._inst_const = None  # disables _batchable next rounds
+                    continue
+                dt = get_usec() - t0
+                self.monitor.add_latency(dt / B, qtype=cls, count=B)
+            else:
+                q = (tmpl.instantiate(rng) if tmpl is not None
+                     else Parser(self.proxy.str_server).parse(
+                         mix.heavies[cls - len(mix.templates)]))
+                heuristic_plan(q)
+                q.result.blind = True
+                eng = self.proxy.tpu if use_tpu else self.proxy.cpu
+                t0 = get_usec()
+                (eng or self.proxy.cpu).execute(q)
+                self.monitor.add_latency(get_usec() - t0, qtype=cls)
+            self.monitor.maybe_print_thpt()
+
+        thpt = self.monitor.thpt()
+        log_info(f"sparql-emu: {thpt:,.0f} q/s over {duration_s}s "
+                 f"({'TPU batch' if use_tpu else 'CPU'} path)")
+        self.monitor.print_cdf()
+        return {"thpt_qps": thpt,
+                "cdf": {c: self.monitor.cdf(c) for c in range(nclasses)}}
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _batchable(tmpl, q_planned) -> bool:
+        """One %placeholder, and the plan's start constant IS that placeholder
+        (otherwise batching would substitute candidates into the wrong slot)."""
+        if tmpl is None or len(tmpl.pos) != 1:
+            return False
+        pats = q_planned.pattern_group.patterns
+        return (bool(pats) and pats[0].subject > 0 and pats[0].predicate > 0
+                and pats[0].subject == getattr(q_planned, "_inst_const", None))
+
+    @staticmethod
+    def _draw_consts(tmpl, rng, B: int) -> np.ndarray:
+        cand = tmpl.candidates[0]
+        return np.asarray(cand[rng.integers(0, len(cand), B)], dtype=np.int64)

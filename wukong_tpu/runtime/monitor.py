@@ -1,0 +1,80 @@
+"""Latency/throughput monitor (reference: core/monitor.hpp:36-233).
+
+Per-query latency records keyed by query id, rolling throughput reporting, and
+per-query-type latency vectors aggregated into a CDF — the same measurements the
+reference's proxy prints during `sparql -n N` and `sparql-emu` runs.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+
+import numpy as np
+
+from wukong_tpu.utils.logger import log_info
+from wukong_tpu.utils.timer import get_usec
+
+
+class Monitor:
+    def __init__(self):
+        self._start: dict[int, int] = {}
+        self.latencies: dict[int, list[int]] = defaultdict(list)  # type -> usecs
+        self.cnt = 0
+        self._t0 = None
+        self._last_print = None
+        self._last_cnt = 0
+
+    # -- per-query records (monitor.hpp start_record/end_record) ----------
+    def start_record(self, qid: int, qtype: int = 0) -> None:
+        self._start[qid] = get_usec()
+
+    def end_record(self, qid: int, qtype: int = 0) -> None:
+        t = get_usec()
+        if qid in self._start:
+            self.latencies[qtype].append(t - self._start.pop(qid))
+            self.cnt += 1
+
+    def add_latency(self, usec: float, qtype: int = 0, count: int = 1) -> None:
+        """Record an aggregate measurement (batched execution)."""
+        self.latencies[qtype].extend([usec] * count)
+        self.cnt += count
+
+    # -- open-loop throughput (monitor.hpp timely print) -------------------
+    def start_thpt(self) -> None:
+        self._t0 = self._last_print = get_usec()
+        self._last_cnt = self.cnt = 0
+        self.latencies.clear()
+
+    def maybe_print_thpt(self, interval_usec: int = 500_000) -> None:
+        now = get_usec()
+        if self._last_print is not None and now - self._last_print > interval_usec:
+            d = now - self._last_print
+            log_info(f"Throughput: {(self.cnt - self._last_cnt) / (d / 1e6):,.0f} q/s")
+            self._last_print = now
+            self._last_cnt = self.cnt
+
+    def thpt(self) -> float:
+        if self._t0 is None:
+            return 0.0
+        dt = get_usec() - self._t0
+        return self.cnt / (dt / 1e6) if dt else 0.0
+
+    # -- CDF (monitor.hpp print_cdf) ---------------------------------------
+    def cdf(self, qtype: int | None = None,
+            points=(0.5, 0.9, 0.95, 0.99, 1.0)) -> dict[float, float]:
+        vals: list = []
+        if qtype is None:
+            for v in self.latencies.values():
+                vals.extend(v)
+        else:
+            vals = list(self.latencies.get(qtype, []))
+        if not vals:
+            return {}
+        arr = np.sort(np.asarray(vals, dtype=np.float64))
+        return {p: float(arr[min(int(p * len(arr)), len(arr) - 1)]) for p in points}
+
+    def print_cdf(self) -> None:
+        for qtype in sorted(self.latencies):
+            c = self.cdf(qtype)
+            line = "  ".join(f"p{int(p * 100)}={v:,.0f}us" for p, v in c.items())
+            log_info(f"Q{qtype + 1} latency CDF ({len(self.latencies[qtype])} samples): {line}")

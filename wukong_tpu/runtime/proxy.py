@@ -1,0 +1,128 @@
+"""Proxy: the client-facing frontend (reference: core/proxy.hpp).
+
+Glues parser -> planner -> engine and implements the reference's query modes:
+- run_single_query: parse, optimize (or apply a user plan), execute with
+  repeats, record latency, print/dump results (proxy.hpp:298-385)
+- run_query_emu: open-loop throughput emulator over template mixes with
+  candidate filling (proxy.hpp:69-129, 391-545) — see emulator.py
+- dynamic_load_data / gstore_check passthroughs (proxy.hpp:548-597)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from wukong_tpu.config import Global
+from wukong_tpu.planner.heuristic import heuristic_plan
+from wukong_tpu.planner.plan_file import set_plan
+from wukong_tpu.runtime.monitor import Monitor
+from wukong_tpu.sparql.ir import SPARQLQuery, SPARQLTemplate
+from wukong_tpu.sparql.parser import Parser
+from wukong_tpu.types import IN
+from wukong_tpu.utils.errors import ErrorCode, WukongError
+from wukong_tpu.utils.logger import log_error, log_info
+from wukong_tpu.utils.timer import get_usec
+
+
+class Proxy:
+    def __init__(self, gstore, str_server, cpu_engine=None, tpu_engine=None,
+                 dist_engine=None, planner=None):
+        self.g = gstore
+        self.str_server = str_server
+        self.cpu = cpu_engine
+        self.tpu = tpu_engine
+        self.dist = dist_engine
+        self.planner = planner  # cost-based optimizer (optional)
+        self.monitor = Monitor()
+
+    # ------------------------------------------------------------------
+    def _plan(self, q: SPARQLQuery, plan_text: str | None = None) -> None:
+        if plan_text is not None:
+            if Global.enable_planner:
+                log_info("user plan ignored: planner is enabled (config)")
+            elif not set_plan(q.pattern_group, plan_text):
+                raise WukongError(ErrorCode.UNKNOWN_PLAN, "bad plan file")
+            else:
+                return
+        if self.planner is not None and Global.enable_planner:
+            if self.planner.generate_plan(q):
+                return
+        heuristic_plan(q)
+
+    def _engine_for(self, q: SPARQLQuery, device: str | None):
+        if device == "tpu" or (device is None and Global.enable_tpu and self.tpu):
+            return self.tpu or self.cpu
+        if device == "dist" and self.dist is not None:
+            return self.dist
+        return self.cpu
+
+    # ------------------------------------------------------------------
+    def run_single_query(self, text: str, repeats: int = 1,
+                         plan_text: str | None = None, mt_factor: int = 1,
+                         device: str | None = None, blind: bool | None = None,
+                         print_results: int = 0) -> SPARQLQuery:
+        """sparql -f <file> [-n repeats] [-p plan] [-m mt] [-N] [-v N] (console.hpp:141-153)."""
+        q = None
+        total_us = 0
+        for i in range(repeats):
+            q = Parser(self.str_server).parse(text)
+            q.mt_factor = min(mt_factor, Global.mt_threshold)
+            q.result.blind = Global.silent if blind is None else blind
+            self._plan(q, plan_text)
+            eng = self._engine_for(q, device)
+            t0 = get_usec()
+            eng.execute(q)
+            total_us += get_usec() - t0
+        if q.result.status_code != ErrorCode.SUCCESS:
+            log_error(f"query failed: {q.result.status_code.name}")
+            return q
+        log_info(f"(last) result rows: {q.result.nrows}, "
+                 f"avg latency: {total_us / repeats:,.0f} usec ({repeats} runs)")
+        if print_results and not q.result.blind:
+            self.print_result(q, min(print_results, q.result.nrows))
+        return q
+
+    def print_result(self, q: SPARQLQuery, rows: int) -> None:
+        """Render rows through the string server (proxy.hpp:247-294)."""
+        for i in range(rows):
+            vals = []
+            for v in q.result.required_vars:
+                col = q.result.v2c_map.get(v)
+                if col is None:
+                    vals.append("?")
+                    continue
+                vid = int(q.result.table[i, col])
+                vals.append(self.str_server.id2str(vid)
+                            if self.str_server.exist_id(vid) else str(vid))
+            log_info(f"  {i + 1}: " + "\t".join(vals))
+
+    # ------------------------------------------------------------------
+    def fill_template(self, tmpl: SPARQLTemplate) -> None:
+        """Collect candidate constants per %placeholder by running the
+        type/predicate index (proxy.hpp:69-129)."""
+        from wukong_tpu.types import is_tpid
+
+        tmpl.candidates = []
+        for tid in tmpl.ptypes:
+            if not is_tpid(tid):
+                raise WukongError(ErrorCode.SYNTAX_ERROR,
+                                  f"placeholder type {tid} is not an index id")
+            cands = np.asarray(self.g.get_index(tid, IN))
+            if len(cands) == 0:
+                raise WukongError(ErrorCode.UNKNOWN_SUB,
+                                  f"no instances for placeholder type {tid}")
+            tmpl.candidates.append(cands)
+
+    # ------------------------------------------------------------------
+    def dynamic_load_data(self, dirname: str, check_dup: bool = False) -> None:
+        raise WukongError(ErrorCode.UNKNOWN_PATTERN,
+                          "dynamic load arrives with the dynamic store")
+
+    def gstore_check(self, index_check: bool = True, normal_check: bool = True) -> int:
+        from wukong_tpu.store.checker import check_partition
+
+        errors = check_partition(self.g, index_check, normal_check)
+        for e in errors[:20]:
+            log_error(f"gsck: {e}")
+        log_info(f"gsck: {'PASS' if not errors else f'{len(errors)} violations'}")
+        return len(errors)
