@@ -1,0 +1,135 @@
+"""Type-centric stats + cost-based planner on LUBM-1."""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+from bgp_oracle import TripleIndex, eval_bgp
+from wukong_tpu.engine.cpu import CPUEngine
+from wukong_tpu.loader.lubm import P, T, VirtualLubmStrings, generate_lubm
+from wukong_tpu.planner.optimizer import Planner, make_planner
+from wukong_tpu.planner.stats import Stats
+from wukong_tpu.sparql.parser import Parser
+from wukong_tpu.store.gstore import build_partition
+from wukong_tpu.types import IN, OUT, TYPE_ID
+
+BASIC = "/root/reference/scripts/sparql_query/lubm/basic"
+
+
+@pytest.fixture(scope="module")
+def world():
+    triples, lay = generate_lubm(1, seed=42)
+    g = build_partition(triples, 0, 1)
+    ss = VirtualLubmStrings(1, seed=42)
+    stats = Stats.generate(triples)
+    return triples, lay, g, ss, stats
+
+
+def test_tyscount_exact(world):
+    triples, lay, g, ss, stats = world
+    c = lay.counts
+    assert stats.tyscount[T["FullProfessor"]] == int(c.n_fp.sum())
+    assert stats.tyscount[T["UndergraduateStudent"]] == int(c.n_ug.sum())
+    assert stats.tyscount[T["Department"]] == c.D
+
+
+def test_pstype_and_fine_type(world):
+    triples, lay, g, ss, stats = world
+    # every worksFor subject is faculty; every object a Department
+    h = stats.pstype[P["worksFor"]]
+    fac_types = {T["FullProfessor"], T["AssociateProfessor"],
+                 T["AssistantProfessor"], T["Lecturer"]}
+    assert set(h) <= fac_types
+    assert set(stats.potype[P["worksFor"]]) == {T["Department"]}
+    # fine_type: FullProfessor --worksFor--> Department, fanout 1
+    ft = stats.fine_type[(T["FullProfessor"], P["worksFor"], OUT)]
+    assert set(ft) == {T["Department"]}
+    assert ft[T["Department"]] == stats.tyscount[T["FullProfessor"]]
+
+
+def test_stats_persistence(world, tmp_path):
+    triples, lay, g, ss, stats = world
+    path = str(tmp_path / "statfile")
+    stats.save(path)
+    st2 = Stats.load(path)
+    assert st2.tyscount == stats.tyscount
+    assert st2.pstype == stats.pstype
+    assert st2.fine_type == stats.fine_type
+    assert np.array_equal(st2.vtype, stats.vtype)
+
+
+QUERIES = [f for f in sorted(glob.glob(f"{BASIC}/lubm_q*")) if os.path.isfile(f)]
+
+
+@pytest.mark.parametrize("qfile", QUERIES,
+                         ids=[os.path.basename(f) for f in QUERIES])
+def test_planner_plans_are_correct(world, qfile):
+    """Cost-based plans produce oracle-correct results for the whole suite."""
+    triples, lay, g, ss, stats = world
+    idx = TripleIndex(triples)
+    planner = Planner(stats)
+    q = Parser(ss).parse(open(qfile).read())
+    raw = [(p.subject, p.predicate, p.object) for p in q.pattern_group.patterns]
+    assert planner.generate_plan(q)
+    eng = CPUEngine(g, ss)
+    eng.execute(q)
+    assert q.result.status_code == 0, q.result.status_code
+    got = sorted(map(tuple, q.result.table.tolist()))
+    want = sorted(eval_bgp(idx, raw, q.result.required_vars))
+    assert got == want, f"{qfile}: {len(got)} vs {len(want)}"
+
+
+def test_planner_picks_selective_start(world):
+    """q4: const dept start (10 rows) must beat the FullProfessor type index."""
+    triples, lay, g, ss, stats = world
+    planner = Planner(stats)
+    q = Parser(ss).parse(open(f"{BASIC}/lubm_q4").read())
+    planner.generate_plan(q)
+    first = q.pattern_group.patterns[0]
+    assert first.subject >= (1 << 17)  # starts from the const department
+
+
+def test_planner_q2_starts_from_course_index(world):
+    triples, lay, g, ss, stats = world
+    planner = Planner(stats)
+    q = Parser(ss).parse(open(f"{BASIC}/lubm_q2").read())
+    planner.generate_plan(q)
+    first = q.pattern_group.patterns[0]
+    assert first.subject == T["Course"] and first.predicate == TYPE_ID
+
+
+def test_make_planner_with_statfile(world, tmp_path):
+    triples, lay, g, ss, stats = world
+    path = str(tmp_path / "statfile")
+    p1 = make_planner(triples, path)
+    assert os.path.exists(path + ".npz")
+    p2 = make_planner(None, path)  # loads without triples
+    assert p2.stats.tyscount == p1.stats.tyscount
+
+
+def test_store_load_stat_console(world, tmp_path):
+    from wukong_tpu.engine.cpu import CPUEngine
+    from wukong_tpu.planner.optimizer import Planner
+    from wukong_tpu.runtime.console import Console
+    from wukong_tpu.runtime.proxy import Proxy
+
+    triples, lay, g, ss, stats = world
+    proxy = Proxy(g, ss, CPUEngine(g, ss))
+    proxy.planner = Planner(stats)
+    c = Console(proxy, stats_path=str(tmp_path / "statfile"))
+    assert c.run_command("store-stat")
+    assert (tmp_path / "statfile.npz").exists()
+    proxy.planner = None
+    assert c.run_command("load-stat")
+    assert proxy.planner is not None
+    assert proxy.planner.stats.tyscount == stats.tyscount
+
+
+def test_planner_readonly_statfile(world):
+    from wukong_tpu.planner.optimizer import make_planner
+
+    triples, lay, g, ss, stats = world
+    p = make_planner(triples, "/proc/definitely/not/writable/statfile")
+    assert p.stats.tyscount  # degraded to in-memory stats, no crash

@@ -33,9 +33,9 @@ store-stat [-f <file>]       store optimizer statistics
 
 
 class Console:
-    def __init__(self, proxy, stats=None):
+    def __init__(self, proxy, stats_path: str | None = None):
         self.proxy = proxy
-        self.stats = stats
+        self.stats_path = stats_path
 
     def run_command(self, line: str) -> bool:
         """Execute one command; returns False to quit."""
@@ -123,14 +123,25 @@ class Console:
         Emulator(self.proxy).run(mix, duration_s=ns.d, warmup_s=ns.w, batch=ns.b)
 
     def _stat(self, rest, load: bool) -> None:
-        if self.stats is None:
-            log_error("optimizer statistics unavailable (no stats module)")
+        """load-stat / store-stat: persist optimizer statistics
+        (console.hpp:977-980 -> stats.hpp:585-640)."""
+        from wukong_tpu.planner.stats import Stats
+
+        path = rest[rest.index("-f") + 1] if "-f" in rest else self.stats_path
+        if path is None:
+            log_error("no statfile path (use -f <file>)")
             return
-        path = rest[rest.index("-f") + 1] if "-f" in rest else None
         if load:
-            self.stats.load(path)
+            from wukong_tpu.planner.optimizer import Planner
+
+            self.proxy.planner = Planner(Stats.load(path))
+            log_info(f"statistics loaded from {path}")
         else:
-            self.stats.store(path)
+            if self.proxy.planner is None:
+                log_error("no planner statistics to store")
+                return
+            self.proxy.planner.stats.save(path)
+            log_info(f"statistics stored to {path}")
 
     # ------------------------------------------------------------------
     def repl(self) -> None:
@@ -164,33 +175,41 @@ def main(argv=None):
     from wukong_tpu.store.string_server import StringServer
     from wukong_tpu.runtime.proxy import Proxy
 
+    import os as _os
+
+    from wukong_tpu.loader.base import load_attr_triples, load_triples
+    from wukong_tpu.store.gstore import build_partition
+
     ss = StringServer(args.dataset)
+    # one read of the triple files serves the partitions, the host fallback
+    # store, and stats generation
+    triples = load_triples(args.dataset)
+    attrs = load_attr_triples(args.dataset)
+    g = build_partition(triples, 0, 1, attrs)
     if args.dist:
         import jax
 
-        from wukong_tpu.loader.base import load_attr_triples, load_triples
         from wukong_tpu.parallel.dist_engine import DistEngine
         from wukong_tpu.parallel.mesh import make_mesh
-        from wukong_tpu.store.gstore import build_partition
 
         n = args.workers or len(jax.devices())
-        # one read of the triple files serves both the N partitions and the
-        # single-partition host fallback store
-        triples = load_triples(args.dataset)
-        attrs = load_attr_triples(args.dataset)
         stores = [build_partition(triples, i, n, attrs) for i in range(n)]
         dist = DistEngine(stores, ss, make_mesh(n))
-        g = build_partition(triples, 0, 1, attrs)
-        del triples
         proxy = Proxy(g, ss, CPUEngine(g, ss),
                       TPUEngine(g, ss) if Global.enable_tpu else None, dist)
     else:
-        stores = load_dataset(args.dataset, 1)
-        g = stores[0]
         proxy = Proxy(g, ss, CPUEngine(g, ss),
                       TPUEngine(g, ss) if Global.enable_tpu else None)
 
-    console = Console(proxy)
+    if Global.enable_planner:
+        from wukong_tpu.planner.optimizer import make_planner
+
+        statfile = _os.path.join(args.dataset, "statfile")
+        proxy.planner = make_planner(
+            None if _os.path.exists(statfile + ".npz") else triples, statfile)
+    del triples
+
+    console = Console(proxy, stats_path=_os.path.join(args.dataset, "statfile"))
     if args.command is not None:
         console.run_command(args.command)
     else:
