@@ -1,0 +1,113 @@
+"""Dynamic (incremental) store: online inserts, gsck, device-cache invalidation."""
+
+import numpy as np
+import pytest
+
+from wukong_tpu.engine.cpu import CPUEngine
+from wukong_tpu.engine.tpu import TPUEngine
+from wukong_tpu.loader.lubm import P, T, VirtualLubmStrings, generate_lubm
+from wukong_tpu.store.checker import check_cross_partition, check_partition
+from wukong_tpu.store.dynamic import insert_triples
+from wukong_tpu.store.gstore import build_all_partitions, build_partition
+from wukong_tpu.types import IN, OUT, TYPE_ID
+
+
+@pytest.fixture()
+def world():
+    triples, lay = generate_lubm(1, seed=42)
+    g = build_partition(triples, 0, 1)
+    ss = VirtualLubmStrings(1, seed=42)
+    return triples, lay, g, ss
+
+
+def test_insert_equals_bulk_build(world):
+    """bulk(all) == bulk(half) + insert(half), segment by segment."""
+    triples, lay, g_full, ss = world
+    half = len(triples) // 2
+    rng = np.random.default_rng(0)
+    perm = rng.permutation(len(triples))
+    a, b = triples[perm[:half]], triples[perm[half:]]
+    g = build_partition(a, 0, 1)
+    insert_triples(g, b)
+    assert set(g.segments) == set(g_full.segments)
+    for k in g_full.segments:
+        assert np.array_equal(g.segments[k].keys, g_full.segments[k].keys), k
+        assert np.array_equal(g.segments[k].edges, g_full.segments[k].edges), k
+    for k in g_full.index:
+        assert np.array_equal(np.sort(g.index[k]), np.sort(g_full.index[k])), k
+    assert check_partition(g) == []
+
+
+def test_insert_new_predicate_and_type(world):
+    triples, lay, g, ss = world
+    NEW_P, NEW_T = 90, 91
+    v1, v2 = 1 << 20, (1 << 20) + 1
+    batch = np.asarray([[v1, NEW_P, v2], [v1, TYPE_ID, NEW_T]], dtype=np.int64)
+    insert_triples(g, batch)
+    assert g.get_triples(v1, NEW_P, OUT).tolist() == [v2]
+    assert g.get_triples(v2, NEW_P, IN).tolist() == [v1]
+    assert g.get_index(NEW_T, IN).tolist() == [v1]
+    assert g.get_index(NEW_P, IN).tolist() == [v1]
+    assert check_partition(g) == []
+
+
+def test_multi_partition_insert_consistent(world):
+    triples, lay, g, ss = world
+    stores = build_all_partitions(triples[: len(triples) // 2], 4)
+    for st in stores:
+        insert_triples(st, triples[len(triples) // 2:])
+    assert check_cross_partition(stores) == []
+
+
+def test_device_cache_invalidation(world):
+    triples, lay, g, ss = world
+    tpu = TPUEngine(g, ss)
+    from wukong_tpu.planner.heuristic import heuristic_plan
+    from wukong_tpu.sparql.parser import Parser
+
+    text = """PREFIX ub: <http://swat.cse.lehigh.edu/onto/univ-bench.owl#>
+    SELECT ?X WHERE { ?X ub:worksFor <http://www.Department0.University0.edu> . }"""
+    q = Parser(ss).parse(text)
+    heuristic_plan(q)
+    tpu.execute(q)
+    before = q.result.nrows
+    # a new professor joins dept0
+    d0 = ss.str2id("<http://www.Department0.University0.edu>")
+    newv = 1 << 22
+    insert_triples(g, np.asarray([[newv, P["worksFor"], d0]], dtype=np.int64))
+    q2 = Parser(ss).parse(text)
+    heuristic_plan(q2)
+    tpu.execute(q2)
+    assert q2.result.nrows == before + 1  # stale staging would miss the insert
+
+
+def test_dedup_on_insert(world):
+    triples, lay, g, ss = world
+    d0 = int(lay.dept_id[0])
+    fp0 = int(lay.fac_base[0])
+    n0 = len(g.get_triples(fp0, P["worksFor"], OUT))
+    insert_triples(g, np.asarray([[fp0, P["worksFor"], d0]], dtype=np.int64),
+                   dedup=True)
+    assert len(g.get_triples(fp0, P["worksFor"], OUT)) == n0  # already present
+
+
+def test_insert_returns_actual_new_edges(world):
+    triples, lay, g, ss = world
+    from wukong_tpu.loader.lubm import P
+    d0 = int(lay.dept_id[0])
+    fp0 = int(lay.fac_base[0])
+    dup = np.asarray([[fp0, P["worksFor"], d0]], dtype=np.int64)
+    assert insert_triples(g, dup, dedup=True) == 0  # already present
+    new = np.asarray([[1 << 23, P["worksFor"], d0]], dtype=np.int64)
+    assert insert_triples(g, new, dedup=True) == 1
+
+
+def test_insert_keep_duplicates(world):
+    triples, lay, g, ss = world
+    from wukong_tpu.loader.lubm import P
+    d0 = int(lay.dept_id[0])
+    fp0 = int(lay.fac_base[0])
+    n0 = len(g.get_triples(fp0, P["worksFor"], OUT))
+    insert_triples(g, np.asarray([[fp0, P["worksFor"], d0]], dtype=np.int64),
+                   dedup=False)
+    assert len(g.get_triples(fp0, P["worksFor"], OUT)) == n0 + 1

@@ -1,0 +1,102 @@
+"""Native C++ host runtime vs numpy fallbacks (equivalence + performance)."""
+
+import numpy as np
+import pytest
+
+from wukong_tpu import native
+from wukong_tpu.engine.device_store import BUCKET, _next_pow2
+
+
+@pytest.fixture(scope="module")
+def lib():
+    lib = native.get_lib()
+    if lib is None:
+        pytest.skip("no C++ toolchain available")
+    return lib
+
+
+def test_parse_id_triples(lib, tmp_path):
+    rng = np.random.default_rng(0)
+    tri = rng.integers(0, 1 << 40, (5000, 3)).astype(np.int64)
+    path = tmp_path / "id_x.nt"
+    with open(path, "w") as f:
+        for s, p, o in tri.tolist():
+            f.write(f"{s}\t{p}\t{o}\n")
+    got = native.parse_id_triples(str(path))
+    assert np.array_equal(got, tri)
+
+
+def test_parse_handles_blank_lines_and_crlf(lib, tmp_path):
+    path = tmp_path / "id_y.nt"
+    path.write_text("1\t2\t3\r\n\n4 5 6\n7\t8\t9")
+    got = native.parse_id_triples(str(path))
+    assert got.tolist() == [[1, 2, 3], [4, 5, 6], [7, 8, 9]]
+
+
+def test_bucket_table_matches_numpy(lib):
+    # compare against the pure-numpy placement (bit-identical policy)
+    import wukong_tpu.native as nat
+    from wukong_tpu.engine import device_store as ds
+
+    rng = np.random.default_rng(1)
+    keys = np.sort(rng.choice(1 << 30, 20000, replace=False)).astype(np.int64)
+    degs = rng.integers(1, 9, len(keys))
+    offsets = np.zeros(len(keys) + 1, dtype=np.int64)
+    np.cumsum(degs, out=offsets[1:])
+    NB = max(_next_pow2((len(keys) + 3) // 4), 2)
+    got = nat.build_bucket_table_native(keys, offsets, NB)
+    assert got is not None
+    # force the numpy path
+    old = nat.build_bucket_table_native
+    try:
+        nat.build_bucket_table_native = lambda *a, **k: None
+        want = ds.build_hash_table(keys, offsets, num_buckets=NB)
+    finally:
+        nat.build_bucket_table_native = old
+    for a, b in zip(got, want):
+        if isinstance(a, np.ndarray):
+            assert np.array_equal(a, b)
+        else:
+            assert a == b
+
+
+def test_sort_triples_matches_lexsort(lib):
+    rng = np.random.default_rng(2)
+    n = 100000
+    p = rng.integers(0, 40, n).astype(np.int64)
+    s = rng.integers(0, 1 << 33, n).astype(np.int64)
+    o = rng.integers(0, 1 << 33, n).astype(np.int64)
+    perm = native.sort_triples_perm(p, s, o)
+    assert perm is not None
+    want = np.lexsort((o, s, p))
+    # stable sorts over identical keys -> identical permutations
+    assert np.array_equal(perm, want)
+
+
+def test_store_build_identical_with_and_without_native(lib):
+    from wukong_tpu.loader.lubm import generate_lubm
+    from wukong_tpu.store.gstore import build_partition
+    import wukong_tpu.native as nat
+
+    triples, _ = generate_lubm(1, seed=3)
+    g_native = build_partition(triples, 0, 2)
+    old_sort, old_bt = nat.sort_triples_perm, nat.build_bucket_table_native
+    try:
+        nat.sort_triples_perm = lambda *a: None
+        nat.build_bucket_table_native = lambda *a, **k: None
+        g_numpy = build_partition(triples, 0, 2)
+    finally:
+        nat.sort_triples_perm, nat.build_bucket_table_native = old_sort, old_bt
+    assert set(g_native.segments) == set(g_numpy.segments)
+    for k in g_native.segments:
+        a, b = g_native.segments[k], g_numpy.segments[k]
+        assert np.array_equal(a.keys, b.keys)
+        assert np.array_equal(a.offsets, b.offsets)
+        assert np.array_equal(a.edges, b.edges)
+
+
+def test_parse_rejects_ragged_lines(lib, tmp_path):
+    path = tmp_path / "id_bad.nt"
+    path.write_text("1\t2\t3\n4\t5\n6\t7\t8\n")  # middle line truncated
+    with pytest.raises(ValueError):
+        native.parse_id_triples(str(path))

@@ -109,3 +109,27 @@ def test_batch_counts_match_single(proxy):
         qi.result.blind = True
         proxy.cpu.execute(qi, from_proxy=False)
         assert counts[i] == qi.result.nrows, (i, int(c))
+
+
+def test_engine_pool_executes_and_steals(proxy):
+    from wukong_tpu.engine.cpu import CPUEngine
+    from wukong_tpu.planner.heuristic import heuristic_plan
+    from wukong_tpu.runtime.scheduler import EnginePool
+    from wukong_tpu.sparql.parser import Parser
+
+    pool = EnginePool(num_engines=4,
+                      make_engine=lambda tid: CPUEngine(proxy.g, proxy.str_server))
+    pool.start()
+    try:
+        qids = []
+        for i in range(16):
+            q = Parser(proxy.str_server).parse(open(f"{BASIC}/lubm_q5").read())
+            heuristic_plan(q)
+            q.result.blind = True
+            # pile everything onto engine 0 so neighbors must steal
+            qids.append(pool.submit(q, tid=0))
+        outs = [pool.wait(qid, timeout=30) for qid in qids]
+        assert all(o is not None and o.result.status_code == 0 for o in outs)
+        assert all(o.result.nrows == outs[0].result.nrows for o in outs)
+    finally:
+        pool.stop()

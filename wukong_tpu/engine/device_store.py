@@ -80,6 +80,12 @@ def build_hash_table(keys: np.ndarray, offsets: np.ndarray,
     """
     K = len(keys)
     NB = num_buckets or max(_next_pow2((K + BUCKET // 2 - 1) // (BUCKET // 2)), 2)
+    # native fast path (bit-identical placement policy)
+    from wukong_tpu.native import build_bucket_table_native
+
+    nat = build_bucket_table_native(np.asarray(keys), np.asarray(offsets), NB)
+    if nat is not None:
+        return nat
     bmask = np.uint32(NB - 1)
     bkey = np.full((NB, BUCKET), -1, dtype=np.int32)
     bstart = np.zeros((NB, BUCKET), dtype=np.int32)
@@ -135,8 +141,21 @@ class DeviceStore:
         self.bytes_used = 0
 
     # ---- segment staging -------------------------------------------------
+    def _check_version(self) -> None:
+        """Dynamic inserts bump the host store's version; drop stale stagings
+        (replaces the reference's lease-based RDMA-cache invalidation,
+        dynamic_gstore.hpp:37-102)."""
+        v = getattr(self.g, "version", 0)
+        if v != getattr(self, "_seen_version", 0):
+            self._cache.clear()
+            self._index_cache.clear()
+            self._lru.clear()
+            self.bytes_used = 0
+            self._seen_version = v
+
     def segment(self, pid: int, d: int) -> DeviceSegment | None:
         """Stage (pid, dir) segment; TYPE_ID IN resolves to the type index CSR."""
+        self._check_version()
         key = (int(pid), int(d))
         if key in self._cache:
             self._touch(key)
@@ -154,6 +173,7 @@ class DeviceStore:
 
     def index_list(self, tpid: int, d: int):
         """Index edge list (type members / pred subjects-objects) on device."""
+        self._check_version()
         key = (int(tpid), int(d))
         if key in self._index_cache:
             return self._index_cache[key]

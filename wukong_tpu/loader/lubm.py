@@ -49,6 +49,13 @@ TYPE_NAMES = [
 P = {name: 2 + i for i, name in enumerate(PRED_NAMES)}
 T = {name: 2 + len(PRED_NAMES) + i for i, name in enumerate(TYPE_NAMES)}
 
+# attribute predicates (typed literals — datagen/add_attribute.cpp analogue):
+# id space continues after types; value types per utils/variant.hpp tags
+ATTR_NAMES = [("age", 1)]  # (name, INT_t)
+A = {name: 2 + len(PRED_NAMES) + len(TYPE_NAMES) + i
+     for i, (name, _t) in enumerate(ATTR_NAMES)}
+ATTR_TYPE = {A[name]: t for (name, t) in ATTR_NAMES}
+
 NUM_RESEARCH = 30  # researchInterest literal pool ("Research0".."Research29")
 
 FACULTY_CLASSES = ["FullProfessor", "AssociateProfessor", "AssistantProfessor", "Lecturer"]
@@ -62,6 +69,11 @@ def index_strings() -> list[tuple[str, int]]:
     for name in TYPE_NAMES:
         rows.append((f"<{UB}{name}>", T[name]))
     return rows
+
+
+def attr_index_strings() -> list[tuple[str, int, int]]:
+    """(string, id, value-type) rows of str_attr_index."""
+    return [(f"<{UB}{name}>", A[name], t) for (name, t) in ATTR_NAMES]
 
 
 # ---------------------------------------------------------------------------
@@ -371,6 +383,19 @@ def generate_lubm(n_univ: int, seed: int = 0):
     return triples, lay
 
 
+def generate_lubm_attrs(n_univ: int, seed: int = 0) -> list[tuple]:
+    """Attribute triples (s, aid, type_tag, value): every undergraduate gets an
+    int `age` (the reference adds typed attrs via add_attribute.cpp)."""
+    c = lubm_counts(n_univ, seed)
+    lay = lubm_layout(c)
+    rng = np.random.Generator(np.random.PCG64([seed, 2]))
+    dept_of_ug = np.repeat(np.arange(c.D), c.n_ug)
+    ug_id = lay.ug_base[dept_of_ug] + _seg_local_index(c.n_ug)
+    ages = rng.integers(17, 24, len(ug_id))
+    aid = A["age"]
+    return [(int(v), aid, 1, int(a)) for v, a in zip(ug_id, ages)]
+
+
 def _sample_courses(rng, student_id, dept_of_student, base, seg_size, lo, hi):
     """Sample lo..hi dept-local courses per student; duplicates dropped.
 
@@ -410,6 +435,10 @@ class VirtualLubmStrings:
         self.lay = lubm_layout(self.counts)
         self._index_s2i = {s: i for s, i in index_strings()}
         self._index_i2s = {i: s for s, i in index_strings()}
+        for s, i, _t in attr_index_strings():
+            self._index_s2i[s] = i
+            self._index_i2s[i] = s
+        self.pid2type = dict(ATTR_TYPE)  # attr predicate -> value-type tag
 
     # -- helpers -----------------------------------------------------------
     def _dept_univ_local(self, d: int) -> tuple[int, int]:
@@ -585,8 +614,15 @@ def write_dataset(outdir: str, n_univ: int, seed: int = 0,
     with open(os.path.join(outdir, "str_index"), "w") as f:
         for s, i in index_strings():
             f.write(f"{s}\t{i}\n")
+    attrs = generate_lubm_attrs(n_univ, seed)
+    with open(os.path.join(outdir, "attr_uni0.nt"), "w") as f:
+        for (sv, aid, t, val) in attrs:
+            f.write(f"{sv}\t{aid}\t{t}\t{val}\n")
+    with open(os.path.join(outdir, "str_attr_index"), "w") as f:
+        for s, i, t in attr_index_strings():
+            f.write(f"{s}\t{i}\t{t}\n")
     meta = {"generator": "lubm", "n_univ": n_univ, "seed": seed,
-            "num_triples": int(len(triples))}
+            "num_triples": int(len(triples)), "num_attrs": len(attrs)}
     with open(os.path.join(outdir, "str_normal_virtual"), "w") as f:
         json.dump(meta, f)
     if write_str_normal:

@@ -115,8 +115,24 @@ class Proxy:
 
     # ------------------------------------------------------------------
     def dynamic_load_data(self, dirname: str, check_dup: bool = False) -> None:
-        raise WukongError(ErrorCode.UNKNOWN_PATTERN,
-                          "dynamic load arrives with the dynamic store")
+        """`load -d <dir> [-c]` (proxy.hpp:548 -> RDFEngine -> DynamicLoader).
+
+        -c (check_dup) opts into duplicate dropping, like the reference's
+        dedup-on-insert option. Inserts reach the host store AND every
+        distributed shard (their version bump restages device caches).
+        """
+        from wukong_tpu.store.dynamic import load_dir_into
+
+        targets = [self.g]
+        if self.dist is not None:
+            targets += [g for g in self.dist.sstore.stores if g is not self.g]
+        n = load_dir_into(targets, dirname, dedup=check_dup)
+        if self.dist is not None:
+            # sharded device arrays are rebuilt lazily from the bumped stores
+            self.dist.sstore._cache.clear()
+            self.dist.sstore._index_cache.clear()
+            self.dist._fn_cache.clear()
+        log_info(f"dynamic load: {n:,} new subject-side edges from {dirname}")
 
     def gstore_check(self, index_check: bool = True, normal_check: bool = True) -> int:
         from wukong_tpu.store.checker import check_partition

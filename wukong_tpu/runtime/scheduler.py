@@ -1,0 +1,117 @@
+"""Host engine pool: per-engine run queues with work stealing.
+
+The reference runs N engine threads per server, each with a private queue,
+priority handling for fork-join sub-queries, work stealing from neighbors
+("work obliger", pair or ring patterns per Global::stealing_pattern), and an
+adaptive busy-poll/snooze loop (core/engine/engine.hpp:78-219). This module
+reproduces that runtime structure for the host-side engines: inter-query
+parallelism across a thread pool (numpy/JAX release the GIL on the heavy ops),
+deque-based queues stolen from the back, and the same pair/ring neighbor
+selection.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+
+from wukong_tpu.config import Global
+from wukong_tpu.utils.timer import get_usec
+
+
+class EnginePool:
+    def __init__(self, num_engines: int | None = None, make_engine=None):
+        """make_engine(tid) -> object with .execute(query) (one per thread,
+        mirroring per-thread SPARQLEngine instances)."""
+        self.n = num_engines or Global.num_engines
+        self.queues = [collections.deque() for _ in range(self.n)]
+        self.locks = [threading.Lock() for _ in range(self.n)]
+        self._make_engine = make_engine
+        self._threads: list[threading.Thread] = []
+        self._stop = threading.Event()
+        self._pending = threading.Semaphore(0)
+        self._results: dict[int, object] = {}
+        self._results_lock = threading.Lock()
+        self._next_qid = 0
+        self._done = {}
+
+    # ------------------------------------------------------------------
+    def start(self) -> None:
+        for tid in range(self.n):
+            t = threading.Thread(target=self._run_engine, args=(tid,),
+                                 daemon=True, name=f"engine-{tid}")
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for _ in self._threads:
+            self._pending.release()
+        for t in self._threads:
+            t.join(timeout=5)
+        self._threads.clear()
+
+    # ------------------------------------------------------------------
+    def submit(self, query, tid: int | None = None) -> int:
+        """Enqueue a query; returns a handle. tid routes like the reference's
+        proxy dst engine choice (round-robin default, proxy.hpp:143-160)."""
+        with self._results_lock:
+            qid = self._next_qid
+            self._next_qid += 1
+            self._done[qid] = threading.Event()
+        t = qid % self.n if tid is None else tid % self.n
+        with self.locks[t]:
+            self.queues[t].append((qid, query))
+        self._pending.release()
+        return qid
+
+    def wait(self, qid: int, timeout: float | None = None):
+        """Returns the engine's result, or raises TimeoutError (the result
+        stays claimable by a later wait — no stranded entries)."""
+        if not self._done[qid].wait(timeout):
+            raise TimeoutError(f"query {qid} still running")
+        with self._results_lock:
+            self._done.pop(qid, None)
+            return self._results.pop(qid, None)
+
+    # ------------------------------------------------------------------
+    def _neighbors(self, tid: int) -> list[int]:
+        """Stealing pattern (engine.hpp:186-207): 0=pair, 1=ring."""
+        if self.n <= 1:
+            return []
+        if Global.stealing_pattern == 1:  # ring: next engine
+            return [(tid + 1) % self.n]
+        return [tid ^ 1] if (tid ^ 1) < self.n else []  # pair
+
+    def _pop_work(self, tid: int):
+        # own queue first (front)
+        with self.locks[tid]:
+            if self.queues[tid]:
+                return self.queues[tid].popleft()
+        # steal from neighbors (back — leave the owner its freshest work)
+        for nb in self._neighbors(tid):
+            with self.locks[nb]:
+                if self.queues[nb]:
+                    return self.queues[nb].pop()
+        return None
+
+    def _run_engine(self, tid: int) -> None:
+        engine = self._make_engine(tid)
+        snooze_us = 10
+        while not self._stop.is_set():
+            item = self._pop_work(tid)
+            if item is None:
+                # adaptive snooze (engine.hpp:120-150: busy poll, then
+                # exponential 10 -> 80 us relax); semaphore bounds the sleep
+                got = self._pending.acquire(timeout=snooze_us / 1e6)
+                snooze_us = 10 if got else min(snooze_us * 2, 80)
+                continue
+            qid, query = item
+            try:
+                out = engine.execute(query)
+            except Exception as e:  # engine errors become the reply
+                out = e
+            with self._results_lock:
+                self._results[qid] = out
+            self._done[qid].set()

@@ -1,0 +1,110 @@
+"""Dynamic (incremental) store: online bulk insertion — the DynamicGStore role.
+
+The reference's dynamic store (core/store/dynamic_gstore.hpp) swaps the bump
+allocator for a real allocator so `load -d <dir>` can insert triples online
+(insert_triple_out/in, :537/:603), with lease-based invalidation so remote
+RDMA-cached reads stay safe. On TPU the RDMA lease machinery disappears
+(SURVEY §7.7): instead each insert batch merge-rebuilds the affected CSR
+segments (sorted-merge, optional dedup like the reference's -c flag) and bumps
+a store version; device-side caches compare versions and restage lazily.
+
+New predicates/types create new segments/indexes, matching DynamicLoader's
+support for unseen predicates (core/loader/dynamic_loader.hpp).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from wukong_tpu.store.gstore import GStore, _pred_runs, _triple_argsort
+from wukong_tpu.store.segment import CSRSegment
+from wukong_tpu.types import IN, NORMAL_ID_START, OUT, TYPE_ID
+from wukong_tpu.utils.mathutil import hash_mod
+
+
+def insert_triples(g: GStore, triples: np.ndarray, dedup: bool = True) -> int:
+    """Insert an [N,3] batch into this partition. Returns #edges inserted
+    (subject-side copies; the object-side copies are inserted symmetrically).
+
+    Bumps g.version so device caches restage affected segments.
+    """
+    s, p, o = triples[:, 0], triples[:, 1], triples[:, 2]
+    n = g.num_workers
+    mine_out = hash_mod(s, n) == g.sid
+    mine_in = (hash_mod(o, n) == g.sid) & (o >= NORMAL_ID_START)
+
+    so, po, oo = s[mine_out], p[mine_out], o[mine_out]
+    si, pi, oi = s[mine_in], p[mine_in], o[mine_in]
+
+    order = _triple_argsort(po, so, oo)
+    so, po, oo = so[order], po[order], oo[order]
+    inserted = 0
+    for pid, ks, vs in _pred_runs(po, so, oo):
+        inserted += _merge_into(g, (pid, OUT), ks, vs, dedup)
+        if pid == TYPE_ID:
+            for t in np.unique(vs):
+                members = np.unique(ks[vs == t])
+                old = g.index.get((int(t), IN), np.empty(0, dtype=np.int64))
+                g.index[(int(t), IN)] = np.union1d(old, members)
+                g.type_ids.add(int(t))
+        else:
+            old = g.index.get((pid, IN), np.empty(0, dtype=np.int64))
+            g.index[(pid, IN)] = np.union1d(old, np.unique(ks))
+
+    order = _triple_argsort(pi, oi, si)
+    si, pi, oi = si[order], pi[order], oi[order]
+    for pid, ks, vs in _pred_runs(pi, oi, si):
+        _merge_into(g, (pid, IN), ks, vs, dedup)
+        old = g.index.get((pid, OUT), np.empty(0, dtype=np.int64))
+        g.index[(pid, OUT)] = np.union1d(old, np.unique(ks))
+
+    # versatile structures
+    if g.vp:
+        g.vp[OUT] = _merge_seg(g.vp.get(OUT), s[mine_out], p[mine_out], True)
+        g.vp[IN] = _merge_seg(g.vp.get(IN), oi, pi, True)
+        g.v_set = np.union1d(g.v_set, np.concatenate([s[mine_out], oi]))
+        tmask = p[mine_out] == TYPE_ID
+        g.t_set = np.union1d(g.t_set, o[mine_out][tmask])
+        g.p_set = np.union1d(
+            g.p_set, np.unique(np.concatenate([p[mine_out][~tmask], pi])))
+
+    g.version = getattr(g, "version", 0) + 1
+    return int(inserted)
+
+
+def _merge_into(g: GStore, key, ks, vs, dedup: bool) -> int:
+    seg = g.segments.get(key)
+    before = seg.num_edges if seg is not None else 0
+    g.segments[key] = _merge_seg(seg, ks, vs, dedup)
+    return g.segments[key].num_edges - before  # actual new edges (post-dedup)
+
+
+def _merge_seg(seg: CSRSegment | None, ks, vs, dedup: bool) -> CSRSegment:
+    if seg is None or seg.num_edges == 0:
+        base_k = np.asarray(ks)
+        base_v = np.asarray(vs)
+        all_k, all_v = base_k, base_v
+    else:
+        old_k = np.repeat(seg.keys, np.diff(seg.offsets))
+        all_k = np.concatenate([old_k, ks])
+        all_v = np.concatenate([seg.edges, vs])
+    if not dedup:
+        order = np.lexsort((all_v, all_k))
+        k, v = all_k[order], all_v[order]
+        keys, counts = np.unique(k, return_counts=True)
+        offsets = np.zeros(len(keys) + 1, dtype=np.int64)
+        np.cumsum(counts, out=offsets[1:])
+        return CSRSegment(keys=keys, offsets=offsets, edges=v)
+    return CSRSegment.from_pairs(all_k, all_v)  # sorts + dedups pairs
+
+
+def load_dir_into(stores: list[GStore], dirname: str, dedup: bool = True) -> int:
+    """`load -d <dir>`: read id-triple files and insert into every partition
+    (the RDFEngine::execute_load_data path, core/engine/rdf.hpp)."""
+    from wukong_tpu.loader.base import load_triples
+
+    triples = load_triples(dirname)
+    total = 0
+    for g in stores:
+        total += insert_triples(g, triples, dedup)
+    return total

@@ -119,6 +119,17 @@ def owner_of_subject(s: np.ndarray, n: int) -> np.ndarray:
     return hash_mod(s, n)
 
 
+def _triple_argsort(primary, secondary, tertiary) -> np.ndarray:
+    """argsort by (primary, secondary, tertiary) — native radix when available
+    (the loader's sorted-run preparation, base_loader.hpp sorts)."""
+    from wukong_tpu.native import sort_triples_perm
+
+    perm = sort_triples_perm(primary, secondary, tertiary)
+    if perm is not None:
+        return perm
+    return np.lexsort((tertiary, secondary, primary))
+
+
 def _pred_runs(p_sorted: np.ndarray, k_sorted: np.ndarray, v_sorted: np.ndarray):
     """Yield (pid, keys, values) slices per predicate run of presorted arrays."""
     if len(p_sorted) == 0:
@@ -151,14 +162,14 @@ def build_partition(triples: np.ndarray, sid: int, num_workers: int,
 
     # ---- normal segments + predicate indexes (one sort per side) ---------
     # pso order: (p, s, o) — each predicate run becomes one OUT segment
-    order = np.lexsort((oo, so, po))
+    order = _triple_argsort(po, so, oo)
     so, po, oo = so[order], po[order], oo[order]
     for pid, ks, vs in _pred_runs(po, so, oo):
         g.segments[(pid, OUT)] = CSRSegment.from_sorted_pairs(ks, vs)
         if pid != TYPE_ID:
             g.index[(pid, IN)] = g.segments[(pid, OUT)].keys.copy()
     # pos order: (p, o, s) — each predicate run becomes one IN segment
-    order = np.lexsort((si, oi, pi))
+    order = _triple_argsort(pi, oi, si)
     si, pi, oi = si[order], pi[order], oi[order]
     for pid, ks, vs in _pred_runs(pi, oi, si):
         g.segments[(pid, IN)] = CSRSegment.from_sorted_pairs(ks, vs)
