@@ -90,42 +90,56 @@ def main():
     eng = TPUEngine(g, ss)
     lat_us = []
     details = {}
+    failed = []
     for i, qn in enumerate([f"lubm_q{k}" for k in range(1, 8)]):
         text = open(f"{BASIC}/{qn}").read()
         q0 = Parser(ss).parse(text)
         heuristic_plan(q0)
         const_start = q0.pattern_group.patterns[0].subject >= (1 << 17)
         best = None
-        for trial in range(3):
-            q = Parser(ss).parse(text)
-            heuristic_plan(q)
-            q.result.blind = True
-            if const_start:
-                consts = np.full(BATCH, q.pattern_group.patterns[0].subject,
-                                 dtype=np.int64)
-                t = time.perf_counter()
-                counts = eng.execute_batch(q, consts)
-                dt = (time.perf_counter() - t) * 1e6 / BATCH
-                nrows = int(counts[0])
-            else:
-                t = time.perf_counter()
-                eng.execute(q)
-                dt = (time.perf_counter() - t) * 1e6
-                nrows = q.result.nrows
-            best = dt if best is None else min(best, dt)
+        nrows = -1
+        try:
+            for trial in range(3):
+                q = Parser(ss).parse(text)
+                heuristic_plan(q)
+                q.result.blind = True
+                if const_start:
+                    consts = np.full(BATCH, q.pattern_group.patterns[0].subject,
+                                     dtype=np.int64)
+                    t = time.perf_counter()
+                    counts = eng.execute_batch(q, consts)
+                    dt = (time.perf_counter() - t) * 1e6 / BATCH
+                    nrows = int(counts[0])
+                else:
+                    t = time.perf_counter()
+                    eng.execute(q)
+                    dt = (time.perf_counter() - t) * 1e6
+                    nrows = q.result.nrows
+                    if q.result.status_code != 0:
+                        raise RuntimeError(
+                            f"{qn} failed: {q.result.status_code!r}")
+                best = dt if best is None else min(best, dt)
+        except Exception as e:  # one bad query must not zero the whole bench
+            failed.append(qn)
+            details[qn] = {"error": str(e)[:200]}
+            print(f"# {qn}: FAILED ({e})", file=sys.stderr)
+            continue
         lat_us.append(best)
         details[qn] = {"us": round(best, 1), "rows": nrows,
                        "batched": const_start}
         print(f"# {qn}: {best:,.0f} us (rows={nrows}"
               f"{', batch=' + str(BATCH) if const_start else ''})",
               file=sys.stderr)
+    if not lat_us:
+        raise SystemExit("all bench queries failed")
 
     ours = _geomean(lat_us)
     ref = _geomean(REF_GPU_LUBM2560)
     print(json.dumps({
         "metric": f"LUBM-{scale} L1-L7 geomean latency, TPU single chip, blind"
                   f" (selective at batch={BATCH}; baseline: reference CUDA"
-                  f" engine @ LUBM-2560)",
+                  f" engine @ LUBM-2560)"
+                  + (f"; FAILED: {','.join(failed)}" if failed else ""),
         "value": round(ours, 1),
         "unit": "us",
         "vs_baseline": round(ref / ours, 3),

@@ -42,7 +42,10 @@ class GlobalConfig:
 
     # ---- TPU-engine knobs (new; no reference analogue) ----
     table_capacity_min: int = 1024  # smallest binding-table capacity class
-    table_capacity_max: int = 1 << 22  # largest capacity class before spill
+    # largest capacity class: 32M rows x 8 cols x int32 = 1 GiB, within one
+    # v5e chip's HBM alongside staged segments (LUBM-2560 heavy queries peak
+    # near 10-30M intermediate rows)
+    table_capacity_max: int = 1 << 25
     exchange_capacity: int = 1 << 16  # per-destination all-to-all row budget
     device_batch: int = 1024  # queries compiled together (emulator batch dim)
 

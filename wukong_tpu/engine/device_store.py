@@ -34,12 +34,14 @@ class DeviceSegment:
     hash table (the reference probes 8-slot cluster-chaining buckets for the
     same locality reason — gstore.hpp:55-120, gpu_hash.cu:149-260; binary
     search over sorted keys lowers to a slow ~21-round scan loop on TPU, and
-    random-gather rounds dominate, so the design minimizes probe rounds and
-    keeps each probe a row-contiguous gather)."""
+    random-gather rounds dominate, so the design minimizes probe rounds).
 
-    bkey: object  # jnp int32 [NB, 8] bucket keys; empty = -1
-    bstart: object  # jnp int32 [NB, 8] edge range start
-    bdeg: object  # jnp int32 [NB, 8] edge range length
+    Bucket arrays are stored FLAT [NB*8]: a [NB, 8] layout would pad the minor
+    dim to 128 lanes on TPU (16x HBM waste — see tpu_kernels.py LAYOUT RULE)."""
+
+    bkey: object  # jnp int32 [NB*8] bucket keys; empty = -1
+    bstart: object  # jnp int32 [NB*8] edge range start
+    bdeg: object  # jnp int32 [NB*8] edge range length
     edges: object  # jnp int32 [E_pad], padded with INT32_MAX
     num_keys: int
     num_edges: int
@@ -207,9 +209,9 @@ class DeviceStore:
             np.asarray(keys), np.asarray(offsets))
         max_deg = int((offsets[1:] - offsets[:-1]).max()) if K else 1
         seg = DeviceSegment(
-            bkey=jax.device_put(jnp.asarray(bkey), self.device),
-            bstart=jax.device_put(jnp.asarray(bstart), self.device),
-            bdeg=jax.device_put(jnp.asarray(bdeg), self.device),
+            bkey=jax.device_put(jnp.asarray(bkey.reshape(-1)), self.device),
+            bstart=jax.device_put(jnp.asarray(bstart.reshape(-1)), self.device),
+            bdeg=jax.device_put(jnp.asarray(bdeg.reshape(-1)), self.device),
             edges=jax.device_put(jnp.asarray(e), self.device),
             num_keys=K, num_edges=E, max_probe=max_probe,
             max_deg_log2=max(int(max_deg).bit_length(), 1),
@@ -221,6 +223,9 @@ class DeviceStore:
         self._cache[key] = seg
         self._lru.append(key)
         self.bytes_used += seg.nbytes
+        self._enforce_budget()
+
+    def _enforce_budget(self) -> None:
         if self.budget is not None:
             while self.bytes_used > self.budget and self._evictable():
                 victim = self._evictable()[0]
@@ -245,6 +250,7 @@ class DeviceStore:
     def unpin(self, keys) -> None:
         for k in keys:
             self._pinned.discard((int(k[0]), int(k[1])))
+        self._enforce_budget()  # pins may have deferred evictions
 
     def prefetch(self, patterns) -> None:
         """Stage the segments of upcoming pattern steps (async via dispatch)."""

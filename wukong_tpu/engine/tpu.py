@@ -45,6 +45,10 @@ class TPUEngine:
                  budget_bytes: int | None = None):
         self.g = gstore
         self.str_server = str_server
+        if budget_bytes is None:
+            # leave headroom for chain buffers: the segment cache gets the
+            # configured share of HBM (gpu_kvcache analogue, Global config)
+            budget_bytes = Global.tpu_mem_cache_gb << 30
         self.dstore = DeviceStore(gstore, budget_bytes=budget_bytes, device=device)
         self.cpu = CPUEngine(gstore, str_server)
         self.cap_min = Global.table_capacity_min
@@ -83,6 +87,21 @@ class TPUEngine:
             device_steps += 1
 
         if device_steps:
+            # pin this query's segments for the chain's lifetime (the
+            # GPUCache conflict-aware eviction analogue, gpu_cache.hpp)
+            pins = [(q.get_pattern(i).predicate, q.get_pattern(i).direction)
+                    for i in range(q.pattern_step, q.pattern_step + device_steps)
+                    if q.get_pattern(i).predicate > 0]
+            self.dstore.pin(pins)
+            try:
+                self._run_chain_pinned(q, device_steps)
+            finally:
+                self.dstore.unpin(pins)
+        # host fallback for any remaining steps
+        while not q.done_patterns():
+            self.cpu._execute_one_pattern(q)
+
+    def _run_chain_pinned(self, q: SPARQLQuery, device_steps: int) -> None:
             # blind queries with nothing after the device chain only need the
             # row count — skip the table transfer entirely (the reference's
             # silent mode never ships result tables, proxy.hpp blind)
@@ -101,6 +120,11 @@ class TPUEngine:
                     break
                 for s, t, c in totals:
                     if t > c:
+                        if t > self.cap_max:
+                            raise WukongError(
+                                ErrorCode.UNKNOWN_PATTERN,
+                                f"intermediate result ({t:,} rows) exceeds "
+                                f"table_capacity_max ({self.cap_max:,})")
                         cap_override[s] = K.next_capacity(int(t), self.cap_min,
                                                           self.cap_max)
             else:
@@ -117,10 +141,6 @@ class TPUEngine:
             q.pattern_step += device_steps
             if device_steps and q.get_pattern(q.pattern_step - 1) is not None:
                 q.local_var = state.local_var
-
-        # host fallback for any remaining steps
-        while not q.done_patterns():
-            self.cpu._execute_one_pattern(q)
 
     def _dispatch_chain(self, q: SPARQLQuery, device_steps: int,
                         cap_override: dict) -> "_ChainState":
@@ -147,7 +167,8 @@ class TPUEngine:
                 if q.mt_factor > 1:
                     lo, hi = _mt_slice(real, q.mt_factor, q.mt_tid)
                     edges, real = edges[lo:hi], hi - lo
-                cap = cap_override.get(step) or K.next_capacity(real, self.cap_min)
+                cap = cap_override.get(step) or K.next_capacity(real, self.cap_min,
+                                                                self.cap_max)
                 table, nn = K.init_from_list(edges, jnp.int32(real), cap)
                 state.begin(table, nn, end, est_rows=real)
                 state.local_var = end
@@ -156,9 +177,10 @@ class TPUEngine:
             assert_ec(q.result.col_num == 0 and state.width == 0,
                       ErrorCode.FIRST_PATTERN_ERROR)
             vids = np.asarray(self.g.get_triples(start, pid, d), dtype=np.int64)
-            cap = cap_override.get(step) or K.next_capacity(len(vids), self.cap_min)
-            pad = np.zeros((cap, 1), dtype=np.int32)
-            pad[: len(vids), 0] = vids
+            cap = cap_override.get(step) or K.next_capacity(len(vids), self.cap_min,
+                                                            self.cap_max)
+            pad = np.zeros((1, cap), dtype=np.int32)  # [width=1, capacity]
+            pad[0, : len(vids)] = vids
             state.begin(jnp.asarray(pad), jnp.int32(len(vids)), end,
                         est_rows=len(vids))
             return
@@ -185,12 +207,12 @@ class TPUEngine:
                                  est_rows=min(est, cap_out))
         else:  # known_to_known / known_to_const
             if seg is None:
-                keep = jnp.zeros(state.table.shape[0], dtype=bool)
+                keep = jnp.zeros(state.table.shape[1], dtype=bool)
             else:
                 if e_known:
-                    vals = state.table[:, e_col]
+                    vals = state.table[e_col]
                 else:
-                    vals = jnp.full(state.table.shape[0], np.int32(end))
+                    vals = jnp.full(state.table.shape[1], np.int32(end))
                 keep = K.member_mask_known(state.table, state.n, vals,
                                            seg.bkey, seg.bstart,
                                            seg.bdeg, seg.edges, col=col,
@@ -237,9 +259,9 @@ class TPUEngine:
             state = _ChainState(q.result)
             # init: [B, 2] — col0 qid, col1 the per-instance start constant
             cap0 = K.next_capacity(B, self.cap_min)
-            init = np.zeros((cap0, 2), dtype=np.int32)
-            init[:B, 0] = np.arange(B)
-            init[:B, 1] = consts
+            init = np.zeros((2, cap0), dtype=np.int32)  # [width, capacity]
+            init[0, :B] = np.arange(B)
+            init[1, :B] = consts
             state.table = jnp.asarray(init)
             state.n = jnp.int32(B)
             state.width = 2
@@ -256,6 +278,11 @@ class TPUEngine:
             over = False
             for (s, _, c), t in zip(state.totals, totals):
                 if int(t) > c:
+                    if int(t) > self.cap_max:
+                        raise WukongError(
+                            ErrorCode.UNKNOWN_PATTERN,
+                            f"batch intermediate ({int(t):,} rows) exceeds "
+                            f"table_capacity_max ({self.cap_max:,})")
                     cap_override[s] = K.next_capacity(int(t), self.cap_min,
                                                       self.cap_max)
                     over = True
@@ -357,7 +384,7 @@ class _ChainState:
         import jax.numpy as jnp
 
         self.table = jnp.concatenate(
-            [self.table, jnp.zeros((self.table.shape[0], 1), jnp.int32)], axis=1)
+            [self.table, jnp.zeros((1, self.table.shape[1]), jnp.int32)], axis=0)
         self.n = jnp.int32(0)
         self.cols[end_var] = self.width
         self.new_cols.append((end_var, self.width))
@@ -378,7 +405,7 @@ class _ChainState:
             host_table = np.empty((0, self.width), dtype=np.int32)
         else:
             host_table, n, totals = jax.device_get((self.table, self.n, scalars))
-            host_table = np.asarray(host_table)
+            host_table = np.ascontiguousarray(np.asarray(host_table).T)
         return (host_table, int(n),
                 [(s, int(t), c) for (s, _, c), t in zip(self.totals, totals)])
 
@@ -399,9 +426,9 @@ def _qid_counts(table, n, B: int):
         import jax.numpy as jnp
 
         def impl(table, n, B: int):
-            C = table.shape[0]
+            C = table.shape[1]
             live = jnp.arange(C, dtype=jnp.int32) < n
-            qid = jnp.where(live, table[:, 0], B)
+            qid = jnp.where(live, table[0], B)
             return jnp.bincount(qid, length=B + 1)[:B]
 
         _qid_counts_jit = functools.partial(

@@ -4,19 +4,25 @@ The reference's GPU hot path (core/gpu/gpu_hash.cu: generate_key_list ->
 get_slot_id_list hash probe -> get_edge_list -> prefix sum -> update_result_buf)
 maps onto shape-stable XLA ops:
 
-- key lookup is an **open-addressing hash probe** (`_hash_find`): a static,
-  bucketed number of gather rounds. (Binary search over sorted keys lowers to a
-  21-iteration scan loop on TPU and measured ~10x slower at 256K-row tables.)
+- key lookup is an 8-way bucketized **hash probe** (`_hash_find`) — binary
+  search over sorted keys lowers to a slow ~21-round scan loop on TPU, so the
+  table is built for 1-2 probe rounds instead.
 - ragged expansion positions come from **scatter + cummax** over the output
-  index space instead of a second searchsorted (gpu_hash.cu's prefix-sum +
-  per-row append, vectorized).
+  index space instead of a second searchsorted.
 - membership (k2k/k2c) is a binary search over each row's sorted edge range
   with a static depth bound (the segment's max degree, recorded at staging).
 
+LAYOUT RULE (v5e): XLA pads a 2-D array's minor dimension to 128 lanes, so any
+[rows, small] array wastes up to 16-32x HBM (a 33M x 8 gather output would pad
+1 GiB to 17 GiB — measured compile OOM). Therefore:
+- binding tables are **transposed**: [width, capacity] with capacity minor;
+- bucket tables are stored **flat** [NB*8], probed with flat gathers and
+  strided-slice lane reduction — no [C, 8] intermediate ever materializes.
+
 All kernels take padded arrays (see device_store) and static capacities, so the
-jit cache is bounded by (log2 sizes x table width x probe bound). Tables are
-int32 [capacity, width]; `n` is the live row count (device scalar). No kernel
-ever forces a host sync — overflow totals ride along as device scalars.
+jit cache is bounded by (log2 sizes x width x probe bound). `n` is the live row
+count (device scalar). No kernel ever forces a host sync — overflow totals ride
+along as device scalars.
 """
 
 from __future__ import annotations
@@ -29,37 +35,47 @@ import numpy as np
 
 INT32_MAX = np.iinfo(np.int32).max
 _HASH_MULT = np.uint32(2654435761)
+BUCKET = 8
 
 
 # ---------------------------------------------------------------------------
-# hashed CSR lookup
+# hashed CSR lookup (flat bucket arrays)
 # ---------------------------------------------------------------------------
 
 
 def _hash_find(bkey, bstart, bdeg, cur, valid, max_probe: int):
-    """(found, start, degree) per cur[i] via 8-way bucket probing.
+    """(found, start, degree) per cur[i]; bkey/bstart/bdeg are flat [NB*8].
 
-    Each probe round is a row-contiguous gather of one bucket (32B), unrolled a
-    static (small) number of rounds — random-gather rounds are the dominant
-    cost on TPU, so the table is built for max_probe 1-2.
+    Per probe round: three flat gathers of [C*8] (groups of 8 consecutive
+    slots) + strided-slice lane reduction. Everything stays 1-D, so nothing
+    hits the 128-lane padding blowup.
     """
-    NB = bkey.shape[0]
+    NB = bkey.shape[0] // BUCKET
     bmask = np.uint32(NB - 1)
-    hb = ((cur.astype(jnp.uint32) * _HASH_MULT) & bmask).astype(jnp.int32)
-    found = jnp.zeros(cur.shape, bool)
+    C = cur.shape[0]
+    hb = (cur.astype(jnp.uint32) * _HASH_MULT) & bmask
+    found = jnp.zeros(C, bool)
     start = jnp.zeros_like(cur)
     deg = jnp.zeros_like(cur)
+    # flat [C*8] index arithmetic (jnp.repeat/tile would lower through a
+    # padded [C, 8] broadcast — the 16x blowup this layout exists to avoid)
+    j = jnp.arange(C * BUCKET, dtype=jnp.int32)
+    row_of_j = j >> 3
+    lane_of_j = j & 7
+    cur8 = cur[row_of_j]
     for r in range(max_probe):
-        rows = ((hb + r).astype(jnp.uint32) & bmask).astype(jnp.int32)
-        kk = bkey[rows]  # [C, 8] contiguous bucket rows
-        hit = kk == cur[:, None]
-        anyhit = hit.any(axis=1) & (~found)
-        lane = jnp.argmax(hit, axis=1)
-        srow = jnp.take_along_axis(bstart[rows], lane[:, None], axis=1)[:, 0]
-        drow = jnp.take_along_axis(bdeg[rows], lane[:, None], axis=1)[:, 0]
-        start = jnp.where(anyhit, srow, start)
-        deg = jnp.where(anyhit, drow, deg)
-        found = found | anyhit
+        rows = (((hb + np.uint32(r)) & bmask).astype(jnp.int32) * BUCKET)
+        idx = rows[row_of_j] + lane_of_j  # [C*8] flat slot ids
+        kk = bkey[idx]
+        hit_flat = kk == cur8
+        ss = bstart[idx]
+        dd = bdeg[idx]
+        for lane in range(BUCKET):
+            h = hit_flat[lane::BUCKET]
+            pick = h & (~found)
+            start = jnp.where(pick, ss[lane::BUCKET], start)
+            deg = jnp.where(pick, dd[lane::BUCKET], deg)
+            found = found | pick
     ok = valid & found
     return ok, jnp.where(ok, start, 0), jnp.where(ok, deg, 0)
 
@@ -84,31 +100,28 @@ def _range_member(edges, lo, hi, vals, depth: int):
 
 
 # ---------------------------------------------------------------------------
-# Pattern kernels
+# Pattern kernels — binding table layout [width, capacity]
 # ---------------------------------------------------------------------------
 
 
 @partial(jax.jit, static_argnames=("col", "cap_out", "max_probe"))
-def expand(table, n, bkey, bstart, bdeg, edges, col, cap_out,
-           max_probe):
+def expand(table, n, bkey, bstart, bdeg, edges, col, cap_out, max_probe):
     """known_to_unknown: expand each live row by its neighbor list.
 
-    Returns (out_table [cap_out, W+1], out_n, total) — total may exceed
-    cap_out; the host checks it at the end-of-chain sync and retries at an
-    exact capacity class (rows are never silently dropped).
+    table: [W, C]. Returns (out [W+1, cap_out], out_n, total) — total may
+    exceed cap_out; the host checks it at the end-of-chain sync and retries at
+    an exact capacity class (rows are never silently dropped).
     """
-    C, W = table.shape
+    W, C = table.shape
     rows = jnp.arange(C, dtype=jnp.int32)
     valid = rows < n
-    cur = table[:, col]
-    found, start, deg = _hash_find(bkey, bstart, bdeg, cur, valid,
-                                   max_probe)
+    cur = table[col]
+    found, start, deg = _hash_find(bkey, bstart, bdeg, cur, valid, max_probe)
     cum = jnp.cumsum(deg)
     total = cum[C - 1]
     starts_excl = cum - deg
-    # scatter each live row's id at its output start, then running max fills
-    # the gaps: src[j] = row covering output position j
-    park = jnp.where(deg > 0, starts_excl, cap_out)  # deg-0 rows drop out
+    # scatter each live row's id at its output start; running max fills gaps
+    park = jnp.where(deg > 0, starts_excl, cap_out)
     marks = jnp.zeros(cap_out, dtype=jnp.int32).at[park].max(
         rows + 1, mode="drop")
     src = jax.lax.cummax(marks) - 1
@@ -118,8 +131,8 @@ def expand(table, n, bkey, bstart, bdeg, edges, col, cap_out,
     E = edges.shape[0]
     val = edges[jnp.clip(eidx, 0, E - 1)]
     out_valid = (j < total) & (src >= 0)
-    out = jnp.concatenate([table[srcc], val[:, None]], axis=1)
-    out = jnp.where(out_valid[:, None], out, 0)
+    out = jnp.concatenate([table[:, srcc], val[None, :]], axis=0)
+    out = jnp.where(out_valid[None, :], out, 0)
     return out, jnp.minimum(total, cap_out).astype(jnp.int32), total
 
 
@@ -127,73 +140,69 @@ def expand(table, n, bkey, bstart, bdeg, edges, col, cap_out,
 def member_mask_known(table, n, vals, bkey, bstart, bdeg, edges,
                       col, max_probe, depth):
     """known_to_known / known_to_const: per-row membership of vals[i] in
-    adj(cur[i]). `vals` is a [C] vector — a bound column or a broadcast const."""
-    C, W = table.shape
+    adj(cur[i]). table: [W, C]; vals: [C]."""
+    W, C = table.shape
     rows = jnp.arange(C, dtype=jnp.int32)
     valid = rows < n
-    cur = table[:, col]
-    found, start, deg = _hash_find(bkey, bstart, bdeg, cur, valid,
-                                   max_probe)
+    cur = table[col]
+    found, start, deg = _hash_find(bkey, bstart, bdeg, cur, valid, max_probe)
     ok = _range_member(edges, start, start + deg, vals, depth)
     return valid & found & ok
 
 
 @jax.jit
 def compact(table, keep):
-    """Keep masked rows, packed to the front. Returns (table, n)."""
-    C = table.shape[0]
+    """Keep masked rows, packed to the front. table: [W, C] -> ([W, C], n)."""
+    W, C = table.shape
     new_n = keep.sum().astype(jnp.int32)
     idx = jnp.nonzero(keep, size=C, fill_value=C - 1)[0]
-    out = table[idx]
+    out = table[:, idx]
     live = jnp.arange(C, dtype=jnp.int32) < new_n
-    return jnp.where(live[:, None], out, 0), new_n
+    return jnp.where(live[None, :], out, 0), new_n
 
 
 @partial(jax.jit, static_argnames=("cap",))
 def init_from_list(edge_list, real_len, cap):
-    """index_to_unknown / const_to_unknown: one-column table from an edge list."""
+    """index/const start: one-row table [1, cap] from an edge list."""
     j = jnp.arange(cap, dtype=jnp.int32)
     E = edge_list.shape[0]
     vals = edge_list[jnp.clip(j, 0, E - 1)]
     valid = j < real_len
-    table = jnp.where(valid[:, None], vals[:, None], 0)
+    table = jnp.where(valid, vals, 0)[None, :]
     return table, jnp.minimum(real_len, cap).astype(jnp.int32)
 
 
 @partial(jax.jit, static_argnames=("col",))
 def member_mask_list(table, n, col, sorted_list, real_len):
-    """index_to_known / const_to_known: membership of a column in a sorted list."""
-    C = table.shape[0]
+    """index_to_known / const_to_known: membership of a row in a sorted list."""
+    W, C = table.shape
     rows = jnp.arange(C, dtype=jnp.int32)
     valid = rows < n
-    vals = table[:, col]
+    vals = table[col]
     L = sorted_list.shape[0]
     depth = max(int(L).bit_length(), 1)
     lo = jnp.zeros(C, dtype=jnp.int32)
-    hi = jnp.full(C, jnp.int32(min(L, INT32_MAX)))
-    hi = jnp.minimum(hi, real_len)
+    hi = jnp.minimum(jnp.full(C, jnp.int32(min(L, INT32_MAX))), real_len)
     ok = _range_member(sorted_list, lo, hi, vals, depth)
     return valid & ok
 
 
 @jax.jit
 def distinct_rows(table, n):
-    """DISTINCT on live rows (device-side sort + neighbor compare)."""
-    C, W = table.shape
-    rows = jnp.arange(C, dtype=jnp.int32)
-    valid = rows < n
-    keyed = jnp.where(valid[:, None], table, INT32_MAX)
+    """DISTINCT on live rows. table: [W, C]."""
+    W, C = table.shape
+    valid = jnp.arange(C, dtype=jnp.int32) < n
+    keyed = jnp.where(valid[None, :], table, INT32_MAX)
     order = jnp.arange(C, dtype=jnp.int32)
     for c in range(W - 1, -1, -1):
-        order = order[jnp.argsort(keyed[order, c], stable=True)]
-    st = keyed[order]
-    same = jnp.all(st[1:] == st[:-1], axis=1)
+        order = order[jnp.argsort(keyed[c, order], stable=True)]
+    st = keyed[:, order]
+    same = jnp.all(st[:, 1:] == st[:, :-1], axis=0)
     keep = jnp.concatenate([jnp.array([True]), ~same]) & (jnp.arange(C) < n)
-    packed, new_n = compact(st, keep)
-    return packed, new_n
+    return compact(st, keep)
 
 
-def next_capacity(total: int, cap_min: int = 1024, cap_max: int = 1 << 24) -> int:
+def next_capacity(total: int, cap_min: int = 1024, cap_max: int = 1 << 26) -> int:
     """Smallest capacity class holding `total` rows."""
     c = cap_min
     while c < total and c < cap_max:

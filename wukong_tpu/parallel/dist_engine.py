@@ -106,12 +106,22 @@ class DistEngine:
             for i, s in enumerate(plan.steps):
                 t = int(totals[:, i].max())
                 if t > s.cap:
+                    if t > self.cap_max:
+                        raise WukongError(
+                            ErrorCode.UNKNOWN_PATTERN,
+                            f"intermediate result ({t:,} rows/shard) exceeds "
+                            f"table_capacity_max ({self.cap_max:,})")
                     cap_override[("cap", i)] = K.next_capacity(
                         t, self.cap_min, self.cap_max)
                     over = True
                 if s.exch_cap:
                     em = int(totals[:, S + i].max())
                     if em > s.exch_cap:
+                        if em > self.cap_max:
+                            raise WukongError(
+                                ErrorCode.UNKNOWN_PATTERN,
+                                f"exchange destination load ({em:,} rows) "
+                                f"exceeds table_capacity_max ({self.cap_max:,})")
                         cap_override[("exch", i)] = K.next_capacity(
                             em, self.cap_min, self.cap_max)
                         over = True
@@ -130,7 +140,7 @@ class DistEngine:
         else:
             parts = []
             for d in range(self.D):
-                parts.append(np.asarray(tables[d][: int(ns[d])]))
+                parts.append(np.asarray(tables[d][:, : int(ns[d])]).T)
             res.set_table(np.concatenate(parts).astype(np.int64)
                           if parts else np.empty((0, plan.width)))
         q.pattern_step = len(q.pattern_group.patterns)
@@ -300,14 +310,14 @@ class DistEngine:
                     arrs = per_step[i]
                     const_tab = jnp.full((1, 1), np.int32(s.const), jnp.int32)
                     if arrs is None:
-                        table = jnp.zeros((s.cap, 1), jnp.int32)
+                        table = jnp.zeros((1, s.cap), jnp.int32)
                         n = jnp.int32(0)
                         continue
                     bkey, bstart, bdeg, edges = arrs
                     table, n, tot = K.expand.__wrapped__(
                         const_tab, jnp.int32(1), bkey, bstart, bdeg, edges,
                         col=0, cap_out=s.cap, max_probe=probes[i])
-                    table = table[:, 1:]  # drop the const column
+                    table = table[1:, :]  # drop the const row ([W, C] layout)
                     totals[i] = tot
                     continue
 
@@ -323,8 +333,8 @@ class DistEngine:
                         table, n = _allgather_rows(table, n, D, axis)
                     if arrs is None:
                         table = jnp.concatenate(
-                            [table, jnp.zeros((table.shape[0], 1), jnp.int32)],
-                            axis=1)
+                            [table, jnp.zeros((1, table.shape[1]), jnp.int32)],
+                            axis=0)
                         n = jnp.int32(0)
                         continue
                     bkey, bstart, bdeg, edges = arrs
@@ -334,13 +344,13 @@ class DistEngine:
                     totals[i] = jnp.maximum(totals[i], tot)
                 elif s.kind == "member":
                     if arrs is None:
-                        keep = jnp.zeros(table.shape[0], bool)
+                        keep = jnp.zeros(table.shape[1], bool)
                     else:
                         bkey, bstart, bdeg, edges = arrs
                         if s.vals_col >= 0:
-                            vals = table[:, s.vals_col]
+                            vals = table[s.vals_col]
                         else:
-                            vals = jnp.full(table.shape[0], np.int32(s.const))
+                            vals = jnp.full(table.shape[1], np.int32(s.const))
                         keep = K.member_mask_known.__wrapped__(
                             table, n, vals, bkey, bstart, bdeg, edges,
                             col=s.col, max_probe=probes[i], depth=depths[i])
@@ -367,20 +377,19 @@ class DistEngine:
 def _exchange(table, n, col, exch_cap: int, cap_new: int, D: int, axis: str):
     """Repartition rows to hash owners of `col` — the fork-join replacement.
 
-    Per-destination capacity-padded all_to_all: send buffer [D, exch_cap, W];
-    per-dest row counts ride along so receivers compact exactly. Returns
-    (table [cap_new, W], n, max_dest_count) — the max count is checked at the
-    end-of-chain sync for overflow retry.
+    table: [W, C]. Per-destination capacity-padded all_to_all: send buffer
+    [D, W, exch_cap]; per-dest row counts ride along so receivers compact
+    exactly. Returns (table [W, cap_new], n, max_dest_count, total_received).
     """
     import jax
     import jax.numpy as jnp
 
-    C, W = table.shape
+    W, C = table.shape
     rows = jnp.arange(C, dtype=jnp.int32)
     live = rows < n
-    dest = jnp.where(live, table[:, col] % D, D)
+    dest = jnp.where(live, table[col] % D, D)
     order = jnp.argsort(dest, stable=True)
-    st = table[order]
+    st = table[:, order]
     sd = dest[order]
     counts = jnp.bincount(dest, length=D + 1)[:D].astype(jnp.int32)
     cumx = jnp.concatenate([jnp.zeros(1, jnp.int32),
@@ -388,38 +397,42 @@ def _exchange(table, n, col, exch_cap: int, cap_new: int, D: int, axis: str):
     within = rows - cumx[jnp.clip(sd, 0, D - 1)]
     slot = jnp.where((sd < D) & (within < exch_cap),
                      sd * exch_cap + within, D * exch_cap)
-    send = jnp.zeros((D * exch_cap, W), jnp.int32).at[slot].set(st, mode="drop")
-    send = send.reshape(D, exch_cap, W)
-    recv = jax.lax.all_to_all(send, axis, split_axis=0, concat_axis=0, tiled=False)
+    send = jnp.zeros((W, D * exch_cap), jnp.int32).at[:, slot].set(
+        st, mode="drop")
+    send = send.reshape(W, D, exch_cap).transpose(1, 0, 2)  # [D, W, exch_cap]
+    recv = jax.lax.all_to_all(send, axis, split_axis=0, concat_axis=0,
+                              tiled=False)
     rcounts = jax.lax.all_to_all(counts.reshape(D, 1), axis, 0, 0,
                                  tiled=False).reshape(D)
     cumr = jnp.concatenate([jnp.zeros(1, jnp.int32),
                             jnp.cumsum(rcounts)[:-1].astype(jnp.int32)])
-    flat = recv.reshape(D * exch_cap, W)
+    flat = recv.transpose(1, 0, 2).reshape(W, D * exch_cap)
     r_in_blk = jnp.tile(jnp.arange(exch_cap, dtype=jnp.int32), D)
     blk = jnp.repeat(jnp.arange(D, dtype=jnp.int32), exch_cap)
     valid = r_in_blk < jnp.minimum(rcounts, exch_cap)[blk]
     pos = jnp.where(valid, cumr[blk] + r_in_blk, cap_new)
-    out = jnp.zeros((cap_new, W), jnp.int32).at[pos].set(flat, mode="drop")
+    out = jnp.zeros((W, cap_new), jnp.int32).at[:, pos].set(flat, mode="drop")
     tot_recv = rcounts.sum().astype(jnp.int32)
     new_n = jnp.minimum(tot_recv, cap_new)
     return out, new_n, counts.max(), tot_recv
 
 
 def _allgather_rows(table, n, D: int, axis: str):
-    """Replicate all live rows to every shard (dispatch-to-all for type steps)."""
+    """Replicate all live rows to every shard (dispatch-to-all for type steps).
+
+    table: [W, C] -> [W, D*C]."""
     import jax
     import jax.numpy as jnp
 
-    C, W = table.shape
-    gat = jax.lax.all_gather(table, axis)  # [D, C, W]
+    W, C = table.shape
+    gat = jax.lax.all_gather(table, axis)  # [D, W, C]
     ns = jax.lax.all_gather(n, axis)  # [D]
-    flat = gat.reshape(D * C, W)
+    flat = gat.transpose(1, 0, 2).reshape(W, D * C)
     blk = jnp.repeat(jnp.arange(D, dtype=jnp.int32), C)
     r_in = jnp.tile(jnp.arange(C, dtype=jnp.int32), D)
     valid = r_in < ns[blk]
     cumn = jnp.concatenate([jnp.zeros(1, jnp.int32),
                             jnp.cumsum(ns)[:-1].astype(jnp.int32)])
     pos = jnp.where(valid, cumn[blk] + r_in, D * C)
-    out = jnp.zeros((D * C, W), jnp.int32).at[pos].set(flat, mode="drop")
+    out = jnp.zeros((W, D * C), jnp.int32).at[:, pos].set(flat, mode="drop")
     return out, ns.sum().astype(jnp.int32)

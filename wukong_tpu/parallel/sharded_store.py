@@ -22,7 +22,7 @@ INT32_MAX = np.iinfo(np.int32).max
 
 @dataclass
 class StackedSegment:
-    bkey: object  # [D, NB, 8] sharded on axis 0
+    bkey: object  # [D, NB*8] sharded on axis 0 (flat buckets per shard)
     bstart: object
     bdeg: object
     edges: object  # [D, E_pad]
@@ -91,9 +91,10 @@ class ShardedDeviceStore:
         for (k, o, e) in shards:
             bk, bs, bd, mp = build_hash_table(np.asarray(k), np.asarray(o),
                                               num_buckets=NB)
-            bkeys.append(bk)
-            bstarts.append(bs)
-            bdegs.append(bd)
+            # flat [NB*8] per shard (see tpu_kernels LAYOUT RULE)
+            bkeys.append(bk.reshape(-1))
+            bstarts.append(bs.reshape(-1))
+            bdegs.append(bd.reshape(-1))
             max_probe = max(max_probe, mp)
             if len(k):
                 max_deg = max(max_deg, int((o[1:] - o[:-1]).max()))
