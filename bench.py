@@ -71,7 +71,29 @@ def _ensure_world(scale: int):
     return g, ss
 
 
+def _probe_backend(deadline_s: int = 240) -> None:
+    """Fail fast (before loading a 16 GiB store) if the TPU backend is dead —
+    a crashed relay worker hangs jax initialization indefinitely."""
+    import subprocess
+
+    try:
+        subprocess.run(
+            [sys.executable, "-c",
+             "import jax, jax.numpy as jnp; "
+             "print(jax.device_get(jnp.arange(2) + 1))"],
+            check=True, timeout=deadline_s, capture_output=True)
+    except subprocess.TimeoutExpired:
+        raise SystemExit(
+            f"bench aborted: device backend unresponsive after {deadline_s}s "
+            "(relay worker likely restarting — retry later)")
+    except subprocess.CalledProcessError as e:
+        raise SystemExit(
+            f"bench aborted: device backend failed to initialize:\n"
+            f"{e.stderr.decode()[-500:]}")
+
+
 def main():
+    _probe_backend()
     scale = int(os.environ.get("WUKONG_BENCH_SCALE", "0"))
     if scale == 0:
         scale = 2560 if (
@@ -89,6 +111,7 @@ def main():
 
     eng = TPUEngine(g, ss)
     lat_us = []
+    ref_us = []  # reference entries for the SAME surviving queries
     details = {}
     failed = []
     for i, qn in enumerate([f"lubm_q{k}" for k in range(1, 8)]):
@@ -125,6 +148,7 @@ def main():
             print(f"# {qn}: FAILED ({e})", file=sys.stderr)
             continue
         lat_us.append(best)
+        ref_us.append(REF_GPU_LUBM2560[i])
         details[qn] = {"us": round(best, 1), "rows": nrows,
                        "batched": const_start}
         print(f"# {qn}: {best:,.0f} us (rows={nrows}"
@@ -134,7 +158,7 @@ def main():
         raise SystemExit("all bench queries failed")
 
     ours = _geomean(lat_us)
-    ref = _geomean(REF_GPU_LUBM2560)
+    ref = _geomean(ref_us)
     print(json.dumps({
         "metric": f"LUBM-{scale} L1-L7 geomean latency, TPU single chip, blind"
                   f" (selective at batch={BATCH}; baseline: reference CUDA"

@@ -102,45 +102,45 @@ class TPUEngine:
             self.cpu._execute_one_pattern(q)
 
     def _run_chain_pinned(self, q: SPARQLQuery, device_steps: int) -> None:
-            # blind queries with nothing after the device chain only need the
-            # row count — skip the table transfer entirely (the reference's
-            # silent mode never ships result tables, proxy.hpp blind)
-            blind_ok = (q.result.blind
-                        and device_steps + q.pattern_step
-                        == len(q.pattern_group.patterns)
-                        and not q.pattern_group.unions
-                        and not q.pattern_group.optional
-                        and not q.pattern_group.filters)
-            cap_override: dict[int, int] = {}
-            for _attempt in range(8):
-                state = self._dispatch_chain(q, device_steps, cap_override)
-                host_table, n, totals = state.sync(blind=blind_ok)
-                over = [s for s, t, c in totals if t > c]
-                if not over:
-                    break
-                for s, t, c in totals:
-                    if t > c:
-                        if t > self.cap_max:
-                            raise WukongError(
-                                ErrorCode.UNKNOWN_PATTERN,
-                                f"intermediate result ({t:,} rows) exceeds "
-                                f"table_capacity_max ({self.cap_max:,})")
-                        cap_override[s] = K.next_capacity(int(t), self.cap_min,
-                                                          self.cap_max)
-            else:
-                raise WukongError(ErrorCode.UNKNOWN_PATTERN,
-                                  "capacity retry limit exceeded")
-            res = q.result
-            if blind_ok:
-                res.nrows = n
-            else:
-                res.set_table(host_table[:n].astype(np.int64))
-            for var, col in state.new_cols:
-                res.add_var2col(var, col)
-            res.col_num = state.width
-            q.pattern_step += device_steps
-            if device_steps and q.get_pattern(q.pattern_step - 1) is not None:
-                q.local_var = state.local_var
+        # blind queries with nothing after the device chain only need the
+        # row count — skip the table transfer entirely (the reference's
+        # silent mode never ships result tables, proxy.hpp blind)
+        blind_ok = (q.result.blind
+                    and device_steps + q.pattern_step
+                    == len(q.pattern_group.patterns)
+                    and not q.pattern_group.unions
+                    and not q.pattern_group.optional
+                    and not q.pattern_group.filters)
+        cap_override: dict[int, int] = {}
+        for _attempt in range(8):
+            state = self._dispatch_chain(q, device_steps, cap_override)
+            host_table, n, totals = state.sync(blind=blind_ok)
+            over = [s for s, t, c in totals if t > c]
+            if not over:
+                break
+            for s, t, c in totals:
+                if t > c:
+                    if t > self.cap_max:
+                        raise WukongError(
+                            ErrorCode.UNKNOWN_PATTERN,
+                            f"intermediate result ({t:,} rows) exceeds "
+                            f"table_capacity_max ({self.cap_max:,})")
+                    cap_override[s] = K.next_capacity(int(t), self.cap_min,
+                                                      self.cap_max)
+        else:
+            raise WukongError(ErrorCode.UNKNOWN_PATTERN,
+                              "capacity retry limit exceeded")
+        res = q.result
+        if blind_ok:
+            res.nrows = n
+        else:
+            res.set_table(host_table[:n].astype(np.int64))
+        for var, col in state.new_cols:
+            res.add_var2col(var, col)
+        res.col_num = state.width
+        q.pattern_step += device_steps
+        if device_steps and q.get_pattern(q.pattern_step - 1) is not None:
+            q.local_var = state.local_var
 
     def _dispatch_chain(self, q: SPARQLQuery, device_steps: int,
                         cap_override: dict) -> "_ChainState":

@@ -202,8 +202,13 @@ def distinct_rows(table, n):
     return compact(st, keep)
 
 
-def next_capacity(total: int, cap_min: int = 1024, cap_max: int = 1 << 26) -> int:
-    """Smallest capacity class holding `total` rows."""
+def next_capacity(total: int, cap_min: int = 1024,
+                  cap_max: int | None = None) -> int:
+    """Smallest capacity class holding `total` rows (ceiling from config)."""
+    if cap_max is None:
+        from wukong_tpu.config import Global
+
+        cap_max = Global.table_capacity_max
     c = cap_min
     while c < total and c < cap_max:
         c <<= 1
