@@ -195,3 +195,47 @@ def test_wrong_suite_engine_errors(world):
     q = Parser(ss).parse(text)
     with pytest.raises(WukongError):
         heuristic_plan(q)
+
+
+def test_corun(world):
+    """CORUN: same kept rows as plain execution for a filter window, and
+    EXISTS semantics for an expansion window (distinct main rows kept)."""
+    from wukong_tpu.config import Global
+    from wukong_tpu.sparql.ir import Pattern
+    from wukong_tpu.types import IN
+
+    triples, g, ss, idx = world
+    eng = CPUEngine(g, ss)
+    d0 = ss.str2id("<http://www.Department0.University0.edu>")
+    memberOf = _p(ss, "memberOf")
+    takes = _p(ss, "takesCourse")
+    ug = _t(ss, "UndergraduateStudent")
+
+    def run(pats, corun=None):
+        from wukong_tpu.sparql.ir import SPARQLQuery
+
+        q = SPARQLQuery()
+        q.pattern_group.patterns = list(pats)
+        q.result.nvars = 2
+        q.result.required_vars = [-1]
+        if corun:
+            q.corun_enabled = True
+            q.corun_step, q.fetch_step = corun
+        old = Global.enable_corun
+        Global.enable_corun = True
+        try:
+            eng.execute(q)
+        finally:
+            Global.enable_corun = old
+        assert q.result.status_code == 0, q.result.status_code
+        return sorted(map(tuple, q.result.table.tolist()))
+
+    base = [Pattern(d0, memberOf, IN, -1), Pattern(-1, 1, 1, ug)]
+    # filter-only window: identical rows
+    assert run(base, corun=(1, 2)) == run(base)
+    assert len(run(base)) > 0
+    # expansion window: corun keeps each main row once (EXISTS semantics)
+    pats2 = [Pattern(d0, memberOf, IN, -1), Pattern(-1, takes, 1, -2)]
+    plain_distinct = sorted({r[0] for r in run(pats2)})
+    corun_rows = run(pats2, corun=(1, 2))
+    assert sorted(r[0] for r in corun_rows) == plain_distinct

@@ -166,6 +166,7 @@ class SPARQLQuery:
     pattern_step: int = 0
     corun_enabled: bool = False
     corun_step: int = 0
+    fetch_step: int = 0
     union_done: bool = False
     optional_step: int = 0
     limit: int = -1

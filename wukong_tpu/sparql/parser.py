@@ -137,6 +137,16 @@ class Parser:
             iri = self._expect("IRI")
             self.prefixes[pre] = iri
 
+        # Wukong CORUN extension (SPARQLParser.hpp:937-958):
+        # `CORUN <corun_step> <fetch_step>` before SELECT
+        corun_enabled = False
+        corun_step = fetch_step = 0
+        if self._peek_kw("CORUN"):
+            self._next()
+            corun_step = int(self._expect("NUM"))
+            fetch_step = int(self._expect("NUM"))
+            corun_enabled = True
+
         self._expect_kw("SELECT")
         distinct = reduced = False
         if self._peek_kw("DISTINCT"):
@@ -192,6 +202,9 @@ class Parser:
         q.distinct = distinct or reduced
         q.limit = limit
         q.offset = offset
+        q.corun_enabled = corun_enabled
+        q.corun_step = corun_step
+        q.fetch_step = fetch_step
         nvars = len(self.vars)
         q.result.nvars = nvars
         if proj is None:
