@@ -1,0 +1,83 @@
+"""WatDiv-family synthesizer: dataset, templates, engines, emulator-style batch."""
+
+import numpy as np
+import pytest
+
+from bgp_oracle import TripleIndex, eval_bgp
+from wukong_tpu.engine.cpu import CPUEngine
+from wukong_tpu.engine.tpu import TPUEngine
+from wukong_tpu.loader.watdiv import (
+    TEMPLATES,
+    VirtualWatdivStrings,
+    generate_watdiv,
+    write_dataset,
+)
+from wukong_tpu.planner.heuristic import heuristic_plan
+from wukong_tpu.runtime.proxy import Proxy
+from wukong_tpu.sparql.parser import Parser
+from wukong_tpu.store.gstore import build_partition
+from wukong_tpu.types import IN
+
+
+@pytest.fixture(scope="module")
+def world():
+    triples, lay = generate_watdiv(20, seed=1)
+    g = build_partition(triples, 0, 1)
+    ss = VirtualWatdivStrings(20, seed=1)
+    idx = TripleIndex(triples)
+    return triples, lay, g, ss, idx
+
+
+def test_scale_and_roundtrip(world):
+    triples, lay, g, ss, idx = world
+    assert len(triples) > 50_000
+    # string roundtrip over a sample
+    rng = np.random.default_rng(0)
+    ids = np.unique(np.concatenate([triples[:, 0], triples[:, 2]]))
+    for vid in rng.choice(ids, 100, replace=False):
+        if ss.exist_id(int(vid)):
+            assert ss.str2id(ss.id2str(int(vid))) == int(vid)
+
+
+@pytest.mark.parametrize("name", sorted(TEMPLATES))
+def test_templates_parse_fill_and_run(world, name):
+    triples, lay, g, ss, idx = world
+    proxy = Proxy(g, ss, CPUEngine(g, ss), TPUEngine(g, ss))
+    tmpl = Parser(ss).parse_template(TEMPLATES[name])
+    proxy.fill_template(tmpl)
+    rng = np.random.default_rng(3)
+    q = tmpl.instantiate(rng)
+    raw = [(p.subject, p.predicate, p.object) for p in q.pattern_group.patterns]
+    heuristic_plan(q)
+    proxy.cpu.execute(q)
+    assert q.result.status_code == 0
+    got = sorted(map(tuple, q.result.table.tolist()))
+    want = sorted(eval_bgp(idx, raw, q.result.required_vars))
+    assert got == want
+
+
+def test_tpu_matches_cpu_on_watdiv(world):
+    triples, lay, g, ss, idx = world
+    tpu = TPUEngine(g, ss)
+    cpu = CPUEngine(g, ss)
+    proxy = Proxy(g, ss, cpu, tpu)
+    tmpl = Parser(ss).parse_template(TEMPLATES["F1"])
+    proxy.fill_template(tmpl)
+    rng = np.random.default_rng(5)
+    qc = tmpl.instantiate(rng)
+    heuristic_plan(qc)
+    cpu.execute(qc)
+    # same instance through the TPU engine
+    qt = tmpl.instantiate(np.random.default_rng(5))
+    heuristic_plan(qt)
+    tpu.execute(qt)
+    assert qt.result.status_code == 0
+    assert sorted(map(tuple, qt.result.table.tolist())) == \
+        sorted(map(tuple, qc.result.table.tolist()))
+
+
+def test_write_dataset(tmp_path):
+    meta = write_dataset(str(tmp_path), 5, seed=2)
+    assert (tmp_path / "id_triples.npy").exists()
+    assert (tmp_path / "queries" / "S1").exists()
+    assert meta["num_triples"] > 10_000

@@ -48,6 +48,12 @@ class StringServer:
                 self._virtual = VirtualLubmStrings(meta["n_univ"], meta["seed"])
                 log_info(f"string server: virtual LUBM backend "
                          f"(n_univ={meta['n_univ']}, seed={meta['seed']})")
+            elif meta.get("generator") == "watdiv":
+                from wukong_tpu.loader.watdiv import VirtualWatdivStrings
+
+                self._virtual = VirtualWatdivStrings(meta["scale"], meta["seed"])
+                log_info(f"string server: virtual WatDiv backend "
+                         f"(scale={meta['scale']}, seed={meta['seed']})")
             else:
                 raise ValueError(f"unknown virtual string backend: {meta}")
 
