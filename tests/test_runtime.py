@@ -133,3 +133,17 @@ def test_engine_pool_executes_and_steals(proxy):
         assert all(o.result.nrows == outs[0].result.nrows for o in outs)
     finally:
         pool.stop()
+
+
+def test_step_trace():
+    from wukong_tpu.runtime.tracing import StepTrace
+
+    tr = StepTrace()
+    with tr.span("expand"):
+        pass
+    with tr.span("expand"):
+        pass
+    with tr.span("member"):
+        pass
+    s = tr.summary()
+    assert s["expand"]["count"] == 2 and s["member"]["count"] == 1

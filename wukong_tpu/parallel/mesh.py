@@ -9,6 +9,26 @@ the same mesh from jax.distributed initialization; nothing else changes.
 from __future__ import annotations
 
 
+def init_multihost(coordinator: str | None = None, num_processes: int | None = None,
+                   process_id: int | None = None) -> None:
+    """Multi-host bring-up (the reference's mpiexec rank assignment,
+    scripts/run.sh + wukong.cpp:102-104): initialize jax.distributed so
+    jax.devices() spans all hosts and make_mesh() lays the partition axis over
+    ICI first, DCN across hosts. No-op when args are absent and the env lacks
+    a coordinator (single-host)."""
+    import os
+
+    import jax
+
+    if coordinator is None and "JAX_COORDINATOR_ADDRESS" not in os.environ \
+            and "COORDINATOR_ADDRESS" not in os.environ:
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id)
+
+
 def make_mesh(n_shards: int | None = None, devices=None, axis: str = "x"):
     import jax
     from jax.sharding import Mesh
