@@ -50,25 +50,41 @@ def _ensure_world(scale: int):
     from wukong_tpu.store.gstore import build_partition
     from wukong_tpu.store.persist import load_gstore, save_gstore
 
+    from wukong_tpu.planner.stats import Stats
+
     os.makedirs(CACHE, exist_ok=True)
     store_path = os.path.join(CACHE, f"lubm{scale}_p0.npz")
+    stats_path = os.path.join(CACHE, f"lubm{scale}_stats.npz")
     ss = VirtualLubmStrings(scale, seed=0)
+    triples = None
+
+    def load_tri():
+        tri_path = os.path.join(REPO, f".cache_lubm{scale}_triples.npy")
+        if os.path.exists(tri_path):
+            return np.asarray(np.load(tri_path, mmap_mode="r"))
+        return generate_lubm(scale, seed=0)[0]
+
     if os.path.exists(store_path):
         g = load_gstore(store_path)
     else:
-        tri_path = os.path.join(REPO, f".cache_lubm{scale}_triples.npy")
-        if os.path.exists(tri_path):
-            triples = np.load(tri_path, mmap_mode="r")
-            triples = np.asarray(triples)
-        else:
-            triples, _ = generate_lubm(scale, seed=0)
+        triples = load_tri()
         g = build_partition(triples, 0, 1)
-        del triples
         try:
             save_gstore(g, store_path)
         except Exception as e:
             print(f"# store cache save failed: {e}", file=sys.stderr)
-    return g, ss
+    if os.path.exists(stats_path):
+        stats = Stats.load(stats_path)
+    else:
+        if triples is None:
+            triples = load_tri()
+        stats = Stats.generate(triples)
+        try:
+            stats.save(stats_path)
+        except Exception as e:
+            print(f"# stats cache save failed: {e}", file=sys.stderr)
+    del triples
+    return g, ss, stats
 
 
 def _probe_backend(deadline_s: int = 240) -> None:
@@ -101,7 +117,7 @@ def main():
             or os.path.exists(os.path.join(REPO, ".cache_lubm2560_triples.npy"))
         ) else 160
     t0 = time.time()
-    g, ss = _ensure_world(scale)
+    g, ss, stats = _ensure_world(scale)
     print(f"# world ready in {time.time() - t0:.0f}s "
           f"({g.stats_str()})", file=sys.stderr)
 
@@ -109,7 +125,7 @@ def main():
     from wukong_tpu.planner.heuristic import heuristic_plan
     from wukong_tpu.sparql.parser import Parser
 
-    eng = TPUEngine(g, ss)
+    eng = TPUEngine(g, ss, stats=stats)
     lat_us = []
     ref_us = []  # reference entries for the SAME surviving queries
     details = {}

@@ -105,3 +105,41 @@ def test_segment_cache_reuse_and_eviction(world):
         tpu.execute(q)
         assert q.result.status_code == 0
     assert tpu.dstore.bytes_used <= (1 << 20) + 4 * (1 << 16)  # budget + slack
+
+
+def test_stats_capacity_estimation_reduces_retries(world):
+    """With planner stats, q2-style expansions should need no capacity retry."""
+    from wukong_tpu.engine import tpu_kernels as K
+    from wukong_tpu.loader.lubm import generate_lubm
+    from wukong_tpu.planner.stats import Stats
+
+    g, ss = world
+    triples, _ = generate_lubm(1, seed=42)
+    stats = Stats.generate(triples)
+    calls = []
+    orig = K.expand
+
+    def counting_expand(*a, **k):
+        calls.append(k.get("cap_out"))
+        return orig(*a, **k)
+
+    text = open(f"{BASIC}/lubm_q2").read()
+    try:
+        K.expand = counting_expand
+        tpu = TPUEngine(g, ss, stats=stats)
+        q = Parser(ss).parse(text)
+        heuristic_plan(q)
+        q.result.blind = True
+        tpu.execute(q)
+        with_stats = len(calls)
+        calls.clear()
+        tpu2 = TPUEngine(g, ss)  # no stats
+        q2 = Parser(ss).parse(text)
+        heuristic_plan(q2)
+        q2.result.blind = True
+        tpu2.execute(q2)
+        without = len(calls)
+    finally:
+        K.expand = orig
+    assert q.result.nrows == q2.result.nrows
+    assert with_stats <= without  # stats never add retries

@@ -42,9 +42,10 @@ class TPUEngine:
     """Executes one SPARQL query with device-resident pattern matching."""
 
     def __init__(self, gstore, str_server=None, device=None,
-                 budget_bytes: int | None = None):
+                 budget_bytes: int | None = None, stats=None):
         self.g = gstore
         self.str_server = str_server
+        self.stats = stats  # optional planner Stats for capacity estimation
         if budget_bytes is None:
             # leave headroom for chain buffers: the segment cache gets the
             # configured share of HBM (gpu_kvcache analogue, Global config)
@@ -195,8 +196,7 @@ class TPUEngine:
             if seg is None:
                 state.append_empty_col(end)
                 return
-            avg_deg = max(1.0, seg.num_edges / max(seg.num_keys, 1))
-            est = int(min(state.est_rows * avg_deg * 2, self.cap_max))
+            est = self._estimate_rows(state, pat, seg)
             cap_out = cap_override.get(step) or K.next_capacity(
                 max(est, self.cap_min), self.cap_min, self.cap_max)
             out, nn, total = K.expand(state.table, state.n, seg.bkey,
@@ -290,6 +290,27 @@ class TPUEngine:
                 return np.asarray(host_counts)
         raise WukongError(ErrorCode.UNKNOWN_PATTERN,
                           "batch capacity retry limit exceeded")
+
+    # ------------------------------------------------------------------
+    def _estimate_rows(self, state, pat, seg) -> int:
+        """Expected output rows of an expansion step.
+
+        With planner statistics: anchor-population-weighted fanout from
+        fine_type (rows * sum(fanout)/anchors, x1.5 safety). Without: segment
+        average degree x2. Both round up to a capacity class; a wrong estimate
+        costs one chain retry, never correctness."""
+        avg_deg = max(1.0, seg.num_edges / max(seg.num_keys, 1))
+        fallback = int(min(state.est_rows * avg_deg * 2, self.cap_max))
+        if self.stats is None:
+            return fallback
+        st = self.stats
+        pe = st.pred_edges.get(pat.predicate)
+        if not pe:
+            return fallback
+        anchors = (st.distinct_subj if pat.direction == OUT
+                   else st.distinct_obj).get(pat.predicate, 0) or 1
+        est = int(min(state.est_rows * (pe / anchors) * 1.5, self.cap_max))
+        return max(est, 1)
 
     # ------------------------------------------------------------------
     def _device_supported(self, q: SPARQLQuery, pat, probe, is_first: bool) -> bool:

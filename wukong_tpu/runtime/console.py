@@ -207,6 +207,8 @@ def main(argv=None):
         statfile = _os.path.join(args.dataset, "statfile")
         proxy.planner = make_planner(
             None if _os.path.exists(statfile + ".npz") else triples, statfile)
+        if proxy.tpu is not None:
+            proxy.tpu.stats = proxy.planner.stats  # capacity estimation
     del triples
 
     console = Console(proxy, stats_path=_os.path.join(args.dataset, "statfile"))
