@@ -198,3 +198,24 @@ def test_execute_batch_rejects_versatile():
     q.pattern_group.patterns = [Pattern(d0, -5, IN, -1)]  # versatile pred var
     with pytest.raises(WukongError):
         tpu.execute_batch(q, np.asarray([d0], dtype=np.int64))
+
+
+def test_distinct_with_hidden_columns():
+    """DISTINCT must dedup projected tuples even when a hidden column
+    separates duplicates in sort order."""
+    import numpy as np
+
+    from wukong_tpu.engine.cpu import CPUEngine
+    from wukong_tpu.sparql.ir import Result, SPARQLQuery
+
+    _, ss, eng = _lubm1_world()
+    q = SPARQLQuery()
+    q.distinct = True
+    res = q.result
+    res.nvars = 2
+    res.required_vars = [-2]
+    res.v2c_map = {-1: 0, -2: 1}
+    res.col_num = 2
+    res.set_table(np.asarray([[1, 9], [2, 7], [3, 9]], dtype=np.int64))
+    eng._final_process(q)
+    assert sorted(r[0] for r in q.result.table.tolist()) == [7, 9]

@@ -730,16 +730,24 @@ class CPUEngine:
 
         table = res.table
         if q.distinct or q.orders:
-            order = np.lexsort(table.T[::-1])
-            table = table[order]
             if q.distinct:
+                # sort by the PROJECTED columns first so adjacent-dedup is a
+                # true DISTINCT. (The reference sorts by all columns and dedups
+                # adjacent rows on projected columns only — final_process,
+                # sparql.hpp:1445-1472 — which misses duplicates separated by
+                # hidden columns; we fix that here.)
                 cols = [res.var2col(v) for v in res.required_vars
                         if not res.is_attr_var(v)]
+                rest = [c for c in range(table.shape[1]) if c not in cols]
+                keys = [table[:, c] for c in reversed(rest)] +                     [table[:, c] for c in reversed(cols)]
+                table = table[np.lexsort(keys)]
                 proj = table[:, cols]
                 keep = np.ones(len(table), dtype=bool)
                 if len(table) > 1:
                     keep[1:] = (proj[1:] != proj[:-1]).any(axis=1)
                 table = table[keep]
+            else:
+                table = table[np.lexsort(table.T[::-1])]
             if q.orders:
                 keys = []
                 for o in reversed(q.orders):

@@ -77,16 +77,37 @@ class DistEngine:
     def execute(self, q: SPARQLQuery, from_proxy: bool = True) -> SPARQLQuery:
         try:
             self._execute_inner(q)
+            # FILTER/FINAL run host-side on the gathered table (they touch
+            # strings and projections, not the graph); UNION/OPTIONAL need
+            # graph patterns and stay unsupported in distributed v1
+            if q.pattern_group.filters or from_proxy:
+                assert_ec(self.str_server is not None or not
+                          (q.pattern_group.filters or q.orders),
+                          ErrorCode.UNKNOWN_FILTER,
+                          "FILTER/ORDER BY needs a string server")
+            if q.pattern_group.filters:
+                self._host()._execute_filters(q)
+            if from_proxy:
+                self._host()._final_process(q)
         except WukongError as e:
             q.result.status_code = e.code
         return q
 
+    def _host(self):
+        from wukong_tpu.engine.cpu import CPUEngine
+
+        if not hasattr(self, "_host_engine"):
+            self._host_engine = CPUEngine(None, self.str_server)
+        return self._host_engine
+
     def _execute_inner(self, q: SPARQLQuery) -> None:
         assert_ec(q.has_pattern, ErrorCode.UNKNOWN_PLAN, "no patterns")
-        if q.pattern_group.unions or q.pattern_group.optional \
-                or q.pattern_group.filters:
+        if q.pattern_group.unions or q.pattern_group.optional:
             raise WukongError(ErrorCode.UNKNOWN_PATTERN,
-                              "distributed engine v1 supports BGP-only plans")
+                              "distributed engine v1 supports BGP(+FILTER) plans")
+        assert_ec(not (q.result.blind and q.pattern_group.filters),
+                  ErrorCode.UNKNOWN_PATTERN,
+                  "blind mode cannot evaluate FILTER phases")
         cap_override: dict[int, int] = {}
         for _attempt in range(8):
             plan = self._build_plan(q, cap_override)
