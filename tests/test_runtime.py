@@ -147,3 +147,33 @@ def test_step_trace():
         pass
     s = tr.summary()
     assert s["expand"]["count"] == 2 and s["member"]["count"] == 1
+
+
+def test_emulator_heavy_mix(proxy, monkeypatch):
+    monkeypatch.setattr(Global, "enable_tpu", False)
+    mix = load_mix_config(
+        "/root/reference/scripts/sparql_query/lubm/emulator/mix_config_heavy",
+        proxy.str_server)
+    assert len(mix.heavies) == 4 and len(mix.templates) == 0
+    out = Emulator(proxy).run(mix, duration_s=0.5, warmup_s=0.1)
+    assert out["thpt_qps"] > 0
+
+
+def test_dist_fallback_on_unsupported_shape():
+    from wukong_tpu.loader.lubm import VirtualLubmStrings, generate_lubm
+    from wukong_tpu.parallel.dist_engine import DistEngine
+    from wukong_tpu.parallel.mesh import make_mesh
+    from wukong_tpu.store.gstore import build_all_partitions, build_partition
+
+    triples, _ = generate_lubm(1, seed=42)
+    ss = VirtualLubmStrings(1, seed=42)
+    g = build_partition(triples, 0, 1)
+    stores = build_all_partitions(triples, 8)
+    dist = DistEngine(stores, ss, make_mesh(8))
+    p = Proxy(g, ss, CPUEngine(g, ss), None, dist)
+    # versatile query: dist rejects, proxy must fall back to the host engine
+    q = p.run_single_query(
+        "SELECT ?X ?P WHERE { ?X ?P <http://www.Department0.University0.edu> . }",
+        device="dist", blind=False)
+    assert q.result.status_code == 0
+    assert q.result.nrows > 0

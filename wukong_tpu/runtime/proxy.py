@@ -72,6 +72,17 @@ class Proxy:
             eng = self._engine_for(q, device)
             t0 = get_usec()
             eng.execute(q)
+            if (q.result.status_code == ErrorCode.UNKNOWN_PATTERN
+                    and eng is self.dist and self.cpu is not None):
+                # distributed v1 rejects some shapes (UNION/OPTIONAL/versatile)
+                # — fall back to a host engine rather than failing the query
+                log_info("distributed engine rejected the plan; "
+                         "falling back to the host engine")
+                q = Parser(self.str_server).parse(text)
+                q.mt_factor = min(mt_factor, Global.mt_threshold)
+                q.result.blind = Global.silent if blind is None else blind
+                self._plan(q, plan_text)
+                (self.tpu or self.cpu).execute(q)
             total_us += get_usec() - t0
         if q.result.status_code != ErrorCode.SUCCESS:
             log_error(f"query failed: {q.result.status_code.name}")
