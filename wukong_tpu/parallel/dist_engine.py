@@ -103,7 +103,7 @@ class DistEngine:
     def _execute_inner(self, q: SPARQLQuery) -> None:
         assert_ec(q.has_pattern, ErrorCode.UNKNOWN_PLAN, "no patterns")
         if q.pattern_group.unions or q.pattern_group.optional:
-            raise WukongError(ErrorCode.UNKNOWN_PATTERN,
+            raise WukongError(ErrorCode.UNSUPPORTED_SHAPE,
                               "distributed engine v1 supports BGP(+FILTER) plans")
         assert_ec(not (q.result.blind and q.pattern_group.filters),
                   ErrorCode.UNKNOWN_PATTERN,
@@ -184,7 +184,7 @@ class DistEngine:
         for i, pat in enumerate(patterns):
             s, p, d, o = pat.subject, pat.predicate, pat.direction, pat.object
             assert_ec(pat.pred_type == int(AttrType.SID_t) and p >= 0,
-                      ErrorCode.UNKNOWN_PATTERN,
+                      ErrorCode.UNSUPPORTED_SHAPE,
                       "attr/versatile unsupported in distributed v1")
             if i == 0 and q.start_from_index():
                 idx = self.sstore.index_list(s, d)
@@ -209,7 +209,7 @@ class DistEngine:
                 continue
 
             col = v2c.get(s, NO_RESULT)
-            assert_ec(col != NO_RESULT, ErrorCode.UNKNOWN_PATTERN,
+            assert_ec(col != NO_RESULT, ErrorCode.UNSUPPORTED_SHAPE,
                       "distributed steps must anchor on a KNOWN subject")
             o_col = v2c.get(o, NO_RESULT) if o < 0 else NO_RESULT
             o_known = o < 0 and o_col != NO_RESULT

@@ -62,28 +62,35 @@ class Proxy:
                          device: str | None = None, blind: bool | None = None,
                          print_results: int = 0) -> SPARQLQuery:
         """sparql -f <file> [-n repeats] [-p plan] [-m mt] [-N] [-v N] (console.hpp:141-153)."""
+        def prepare():
+            qq = Parser(self.str_server).parse(text)
+            qq.mt_factor = min(mt_factor, Global.mt_threshold)
+            qq.result.blind = Global.silent if blind is None else blind
+            self._plan(qq, plan_text)
+            return qq
+
         q = None
         total_us = 0
         for i in range(repeats):
-            q = Parser(self.str_server).parse(text)
-            q.mt_factor = min(mt_factor, Global.mt_threshold)
-            q.result.blind = Global.silent if blind is None else blind
-            self._plan(q, plan_text)
+            q = prepare()
             eng = self._engine_for(q, device)
             t0 = get_usec()
             eng.execute(q)
-            if (q.result.status_code == ErrorCode.UNKNOWN_PATTERN
-                    and eng is self.dist and self.cpu is not None):
-                # distributed v1 rejects some shapes (UNION/OPTIONAL/versatile)
-                # — fall back to a host engine rather than failing the query
-                log_info("distributed engine rejected the plan; "
-                         "falling back to the host engine")
-                q = Parser(self.str_server).parse(text)
-                q.mt_factor = min(mt_factor, Global.mt_threshold)
-                q.result.blind = Global.silent if blind is None else blind
-                self._plan(q, plan_text)
-                (self.tpu or self.cpu).execute(q)
             total_us += get_usec() - t0
+            if (q.result.status_code == ErrorCode.UNSUPPORTED_SHAPE
+                    and eng is self.dist):
+                # the distributed engine rejects some shapes up front
+                # (UNION/OPTIONAL/versatile) — fall back to the configured
+                # host engine. Capacity-exhaustion failures keep their error
+                # status (falling back would materialize the oversized table
+                # on one host).
+                log_info("distributed engine rejected the plan shape; "
+                         "falling back to the host engine")
+                q = prepare()
+                host = self._engine_for(q, None) or self.cpu
+                t0 = get_usec()
+                host.execute(q)
+                total_us += get_usec() - t0
         if q.result.status_code != ErrorCode.SUCCESS:
             log_error(f"query failed: {q.result.status_code.name}")
             return q

@@ -22,6 +22,7 @@ class ErrorCode(enum.IntEnum):
     UNKNOWN_FILTER = 9
     FIRST_PATTERN_ERROR = 10  # start pattern must begin an empty table
     UNKNOWN_PLAN = 11
+    UNSUPPORTED_SHAPE = 12  # engine cannot run this plan shape (fallback-able)
 
 
 _MESSAGES = {
@@ -37,6 +38,7 @@ _MESSAGES = {
     ErrorCode.UNKNOWN_FILTER: "unsupported FILTER expression",
     ErrorCode.FIRST_PATTERN_ERROR: "start pattern applied to a non-empty table",
     ErrorCode.UNKNOWN_PLAN: "invalid or missing query plan",
+    ErrorCode.UNSUPPORTED_SHAPE: "plan shape unsupported by this engine",
 }
 
 
