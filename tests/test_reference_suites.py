@@ -23,7 +23,7 @@ from wukong_tpu.utils.errors import ErrorCode, WukongError
 SUITES = "/root/reference/scripts/sparql_query/lubm"
 
 FILES = sorted(
-    f for suite in ("union", "optional", "filter", "order", "dedup", "batch")
+    f for suite in ("union", "optional", "filter", "order", "dedup", "attr")
     for f in glob.glob(f"{SUITES}/{suite}/*")
     if os.path.isfile(f) and not f.endswith(".md") and "README" not in f)
 

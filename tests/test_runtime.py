@@ -177,3 +177,31 @@ def test_dist_fallback_on_unsupported_shape():
         device="dist", blind=False)
     assert q.result.status_code == 0
     assert q.result.nrows > 0
+
+
+def test_sparql_batch_mode(proxy, tmp_path):
+    c = Console(proxy)
+    batch = tmp_path / "batch"
+    batch.write_text(
+        f"sparql -f {BASIC}/lubm_q5 -d cpu\n"
+        f"# comment line\n"
+        f"sparql -f {BASIC}/lubm_q4 -d cpu -n 2\n")
+    assert c.run_command(f"sparql -b {batch}")
+    # exclusive flags rejected cleanly (error logged, nothing executed)
+    import wukong_tpu.runtime.console as con
+
+    errors = []
+    orig = con.log_error
+    con.log_error = lambda msg: errors.append(msg)
+    try:
+        assert c.run_command(f"sparql -f {BASIC}/lubm_q5 -b {batch}")
+        assert c.run_command("sparql")
+        assert c.run_command("sparql -b /no/such/file")
+        nested = batch.parent / "nested"
+        nested.write_text(f"sparql -b {nested}\n")
+        assert c.run_command(f"sparql -b {nested}")
+    finally:
+        con.log_error = orig
+    assert len(errors) == 4
+    assert "exclusive" in errors[0] and "exclusive" in errors[1]
+    assert "cannot read" in errors[2] and "nested" in errors[3]

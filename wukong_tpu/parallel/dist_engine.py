@@ -106,7 +106,7 @@ class DistEngine:
             raise WukongError(ErrorCode.UNSUPPORTED_SHAPE,
                               "distributed engine v1 supports BGP(+FILTER) plans")
         assert_ec(not (q.result.blind and q.pattern_group.filters),
-                  ErrorCode.UNKNOWN_PATTERN,
+                  ErrorCode.UNSUPPORTED_SHAPE,
                   "blind mode cannot evaluate FILTER phases")
         cap_override: dict[int, int] = {}
         for _attempt in range(8):

@@ -23,6 +23,7 @@ config <-v | -l <file> | -s <string>>   show/load/set config
 logger <level>               set log level (0..7)
 sparql -f <file> [-m <f>] [-n <n>] [-p <plan>] [-N] [-v <n>] [-d cpu|tpu|dist]
                              run a single SPARQL query
+sparql -b <file>             run a batch of `sparql` commands from a file
 sparql-emu -f <mix_config> [-d <sec>] [-w <sec>] [-b <batch>]
                              run the open-loop throughput emulator
 load -d <dir>                dynamic (incremental) load
@@ -95,7 +96,10 @@ class Console:
 
     def _sparql(self, rest) -> None:
         ap = argparse.ArgumentParser(prog="sparql")
-        ap.add_argument("-f", required=True)
+        ap.add_argument("-f", default=None)
+        ap.add_argument("-b", default=None,
+                        help="batch file: one `sparql ...` command per line "
+                             "(console.hpp:151, exclusive with -f)")
         ap.add_argument("-m", type=int, default=1)
         ap.add_argument("-n", type=int, default=1)
         ap.add_argument("-p", default=None)
@@ -103,6 +107,31 @@ class Console:
         ap.add_argument("-v", type=int, default=0, help="print first N rows")
         ap.add_argument("-d", default=None, choices=["cpu", "tpu", "dist"])
         ns = ap.parse_args(rest)
+        if (ns.f is None) == (ns.b is None):
+            log_error("single mode (-f) and batch mode (-b) are exclusive "
+                      "— pass exactly one")
+            return
+        if ns.b is not None:
+            if getattr(self, "_in_batch", False):
+                log_error("nested batch files are not allowed")
+                return
+            try:
+                lines = open(ns.b).read().splitlines()
+            except OSError as e:
+                log_error(f"cannot read batch file: {e}")
+                return
+            log_info("Batch-mode start ...")
+            self._in_batch = True
+            try:
+                for line in lines:
+                    line = line.strip()
+                    if not line or line.startswith("#"):
+                        continue
+                    log_info(f"Run the command: {line}")
+                    self.run_command(line)
+            finally:
+                self._in_batch = False
+            return
         text = open(ns.f).read()
         plan = open(ns.p).read() if ns.p else None
         blind = None if not (ns.N or ns.v) else False

@@ -69,6 +69,8 @@ class Proxy:
             self._plan(qq, plan_text)
             return qq
 
+        if repeats < 1:
+            raise WukongError(ErrorCode.SYNTAX_ERROR, "repeats must be >= 1")
         q = None
         total_us = 0
         for i in range(repeats):
@@ -86,8 +88,10 @@ class Proxy:
                 # on one host).
                 log_info("distributed engine rejected the plan shape; "
                          "falling back to the host engine")
-                q = prepare()
                 host = self._engine_for(q, None) or self.cpu
+                if host is None or host is self.dist:
+                    break  # no host engine available: keep the error status
+                q = prepare()
                 t0 = get_usec()
                 host.execute(q)
                 total_us += get_usec() - t0
