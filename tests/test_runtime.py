@@ -205,3 +205,12 @@ def test_sparql_batch_mode(proxy, tmp_path):
     assert len(errors) == 4
     assert "exclusive" in errors[0] and "exclusive" in errors[1]
     assert "cannot read" in errors[2] and "nested" in errors[3]
+
+
+def test_mt_factor_never_truncates_results(proxy):
+    """-m must not silently slice the index scan on single-driver engines."""
+    full = proxy.run_single_query(open(f"{BASIC}/lubm_q2").read(),
+                                  device="cpu", blind=True)
+    sliced = proxy.run_single_query(open(f"{BASIC}/lubm_q2").read(),
+                                    device="cpu", blind=True, mt_factor=8)
+    assert sliced.result.nrows == full.result.nrows

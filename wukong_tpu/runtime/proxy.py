@@ -62,9 +62,18 @@ class Proxy:
                          device: str | None = None, blind: bool | None = None,
                          print_results: int = 0) -> SPARQLQuery:
         """sparql -f <file> [-n repeats] [-p plan] [-m mt] [-N] [-v N] (console.hpp:141-153)."""
+        if mt_factor > 1:
+            # the reference fans an index scan out to mt_factor threads and
+            # merges replies (sparql.hpp:1064-1088). The single-driver engines
+            # here scan the whole index vectorized in one kernel, and the
+            # distributed engine shards scans per partition — so -m is a no-op
+            # rather than a partial-result slice.
+            log_info("-m (mt_factor) is vectorized away on this engine; "
+                     "running the full index scan")
+
         def prepare():
             qq = Parser(self.str_server).parse(text)
-            qq.mt_factor = min(mt_factor, Global.mt_threshold)
+            qq.mt_factor = 1
             qq.result.blind = Global.silent if blind is None else blind
             self._plan(qq, plan_text)
             return qq
