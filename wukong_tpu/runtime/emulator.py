@@ -115,10 +115,13 @@ class Emulator:
                 dt = get_usec() - t0
                 self.monitor.add_latency(dt / B, qtype=cls, count=B)
             else:
-                q = (tmpl.instantiate(rng) if tmpl is not None
-                     else Parser(self.proxy.str_server).parse(
-                         mix.heavies[cls - len(mix.templates)]))
-                heuristic_plan(q)
+                import copy
+
+                if tmpl is not None:
+                    q = tmpl.instantiate(rng)
+                    heuristic_plan(q)
+                else:
+                    q = copy.deepcopy(q0)  # heavy classes reuse the cached plan
                 q.result.blind = True
                 eng = self.proxy.tpu if use_tpu else self.proxy.cpu
                 t0 = get_usec()
