@@ -103,7 +103,6 @@ class Result:
         self.status_code = ErrorCode.SUCCESS
         self.nrows = 0  # meaningful even when blind/table cleared
         self.optional_matched_rows: np.ndarray | None = None
-        self.device_cached = None  # TPU engine: table resident on device
 
     def var2col(self, var: int) -> int:
         return self.v2c_map.get(var, NO_RESULT)
