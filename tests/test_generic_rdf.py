@@ -1,0 +1,86 @@
+"""DBpedia-shaped mixed workload: complex types, hub skew, engine equivalence."""
+
+import numpy as np
+import pytest
+
+from bgp_oracle import TripleIndex, eval_bgp
+from wukong_tpu.engine.cpu import CPUEngine
+from wukong_tpu.engine.tpu import TPUEngine
+from wukong_tpu.loader.generic_rdf import generate_generic
+from wukong_tpu.planner.optimizer import Planner
+from wukong_tpu.planner.stats import Stats
+from wukong_tpu.sparql.ir import Pattern, SPARQLQuery
+from wukong_tpu.store.checker import check_cross_partition, check_partition
+from wukong_tpu.store.gstore import build_all_partitions, build_partition
+from wukong_tpu.types import IN, OUT, TYPE_ID
+
+
+@pytest.fixture(scope="module")
+def world():
+    triples, meta = generate_generic(20_000, n_preds=80, n_types=20, seed=5)
+    g = build_partition(triples, 0, 1)
+    stats = Stats.generate(triples)
+    return triples, meta, g, stats
+
+
+def test_store_consistency(world):
+    triples, meta, g, stats = world
+    assert check_partition(g) == []
+    stores = build_all_partitions(triples, 4)
+    assert check_cross_partition(stores) == []
+
+
+def test_complex_types_synthesized(world):
+    triples, meta, g, stats = world
+    # multi-typed and untyped entities must produce complex type ids (<0)
+    assert any(t < 0 for t in stats.tyscount)
+    assert stats.complex_members  # at least one multi-type composition
+    # every complex member set contains real type ids
+    for cid, members in stats.complex_members.items():
+        assert all(m >= 0 for m in members)
+
+
+def test_planner_on_heterogeneous_graph(world):
+    triples, meta, g, stats = world
+    planner = Planner(stats)
+    idx = TripleIndex(triples)
+    # mixed query: hub anchor + type filter + expansion
+    hub = meta["hubs"][0]
+    pid = int(triples[triples[:, 1] > TYPE_ID][0, 1])
+    q = SPARQLQuery()
+    q.pattern_group.patterns = [
+        Pattern(-1, pid, OUT, hub),
+        Pattern(-1, TYPE_ID, OUT, -2),
+    ]
+    q.result.nvars = 2
+    q.result.required_vars = [-1, -2]
+    raw = [(p.subject, p.predicate, p.object) for p in q.pattern_group.patterns]
+    assert planner.generate_plan(q)
+    eng = CPUEngine(g, None)
+    eng.execute(q, from_proxy=False)
+    assert q.result.status_code == 0
+    cols = [q.result.v2c_map[-1], q.result.v2c_map[-2]]
+    got = sorted(map(tuple, q.result.table[:, cols].tolist()))
+    want = sorted(eval_bgp(idx, raw, [-1, -2]))
+    assert got == want
+
+
+def test_tpu_matches_cpu_on_hub_query(world):
+    triples, meta, g, stats = world
+    hub = meta["hubs"][0]
+    pid = int(triples[triples[:, 1] > TYPE_ID][0, 1])
+    mk = lambda: _mk_query(hub, pid)
+    qc, qt = mk(), mk()
+    CPUEngine(g, None).execute(qc, from_proxy=False)
+    TPUEngine(g, None, stats=stats).execute(qt, from_proxy=False)
+    assert qt.result.status_code == 0
+    assert sorted(map(tuple, qt.result.table.tolist())) == \
+        sorted(map(tuple, qc.result.table.tolist()))
+
+
+def _mk_query(hub, pid):
+    q = SPARQLQuery()
+    q.pattern_group.patterns = [Pattern(hub, pid, IN, -1)]
+    q.result.nvars = 1
+    q.result.required_vars = [-1]
+    return q
