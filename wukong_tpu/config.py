@@ -25,7 +25,11 @@ class GlobalConfig:
     memstore_size_gb: int = 4
     est_bdr_threshold: int = 0  # reserved (reference RDMA buffer sizing)
     enable_tpu: bool = True  # accelerator engine on (reference: USE_GPU path)
-    tpu_mem_cache_gb: int = 8  # HBM segment-cache budget (reference: gpu_kvcache)
+    # HBM segment-cache budget (reference: gpu_kvcache). Conservative default:
+    # heavy-chain buffers at 32M-row capacity classes can hold several GiB
+    # live while dispatches pipeline, and a worker OOM crash takes the whole
+    # relay down — leave most of the 16 GiB to chain buffers.
+    tpu_mem_cache_gb: int = 4
     enable_dynamic_store: bool = False  # append-only delta segments
     enable_versatile: bool = True  # variable-predicate support (USE_VERSATILE)
 
