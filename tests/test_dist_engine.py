@@ -145,3 +145,45 @@ def test_dist_filter_and_projection(world):
     got = sorted(map(tuple, qd.result.table.tolist()))
     want = sorted(map(tuple, qc.result.table.tolist()))
     assert got == want and len(got) == 3
+
+
+def test_dist_top_level_union(world):
+    """union/q1: each branch runs distributed, results merge host-side."""
+    ss, cpu, dist = world
+    text = open(
+        "/root/reference/scripts/sparql_query/lubm/union/q1").read()
+    qc = Parser(ss).parse(text)
+    heuristic_plan(qc)
+    cpu.execute(qc)
+    assert qc.result.status_code == 0
+    qd = Parser(ss).parse(text)
+    heuristic_plan(qd)
+    dist.execute(qd)
+    assert qd.result.status_code == 0
+    got = sorted(map(tuple, qd.result.table.tolist()))
+    want = sorted(map(tuple, qc.result.table.tolist()))
+    assert got == want and len(got) > 0
+
+
+def test_dist_union_branch_filters(world):
+    """Branch-level FILTERs inside a distributed UNION must be applied."""
+    ss, cpu, dist = world
+    text = """
+    PREFIX rdf: <http://www.w3.org/1999/02/22-rdf-syntax-ns#>
+    PREFIX ub: <http://swat.cse.lehigh.edu/onto/univ-bench.owl#>
+    SELECT ?X ?Y WHERE {
+        { ?X rdf:type ub:Course . ?X ub:name ?Y .
+          FILTER regex(?Y, "Course1.*") }
+        UNION
+        { ?X rdf:type ub:GraduateCourse . ?X ub:name ?Y . }
+    }"""
+    qc = Parser(ss).parse(text)
+    heuristic_plan(qc)
+    cpu.execute(qc)
+    qd = Parser(ss).parse(text)
+    heuristic_plan(qd)
+    dist.execute(qd)
+    assert qd.result.status_code == 0
+    got = sorted(map(tuple, qd.result.table.tolist()))
+    want = sorted(map(tuple, qc.result.table.tolist()))
+    assert got == want and 0 < len(got)

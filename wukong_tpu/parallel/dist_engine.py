@@ -78,8 +78,9 @@ class DistEngine:
         try:
             self._execute_inner(q)
             # FILTER/FINAL run host-side on the gathered table (they touch
-            # strings and projections, not the graph); UNION/OPTIONAL need
-            # graph patterns and stay unsupported in distributed v1
+            # strings and projections, not the graph). Top-level UNION runs
+            # branch-per-branch in _execute_inner; OPTIONAL stays unsupported
+            # in distributed v1
             if q.pattern_group.filters or from_proxy:
                 assert_ec(self.str_server is not None or not
                           (q.pattern_group.filters or q.orders),
@@ -101,10 +102,17 @@ class DistEngine:
         return self._host_engine
 
     def _execute_inner(self, q: SPARQLQuery) -> None:
+        if q.pattern_group.unions and not q.has_pattern \
+                and not q.pattern_group.optional:
+            # top-level UNION: each branch is an independent distributed BGP;
+            # branch results merge host-side (Result::merge_result semantics)
+            self._execute_union_branches(q)
+            return
         assert_ec(q.has_pattern, ErrorCode.UNKNOWN_PLAN, "no patterns")
         if q.pattern_group.unions or q.pattern_group.optional:
             raise WukongError(ErrorCode.UNSUPPORTED_SHAPE,
-                              "distributed engine v1 supports BGP(+FILTER) plans")
+                              "distributed engine v1 supports BGP(+FILTER) "
+                              "and top-level-UNION plans")
         assert_ec(not (q.result.blind and q.pattern_group.filters),
                   ErrorCode.UNSUPPORTED_SHAPE,
                   "blind mode cannot evaluate FILTER phases")
@@ -165,6 +173,30 @@ class DistEngine:
             res.set_table(np.concatenate(parts).astype(np.int64)
                           if parts else np.empty((0, plan.width)))
         q.pattern_step = len(q.pattern_group.patterns)
+
+    def _execute_union_branches(self, q: SPARQLQuery) -> None:
+        merged = None
+        host = self._host()
+        for sub_pg in q.pattern_group.unions:
+            assert_ec(not sub_pg.unions and not sub_pg.optional,
+                      ErrorCode.UNSUPPORTED_SHAPE,
+                      "nested groups inside UNION branches are unsupported "
+                      "in distributed v1")
+            child = SPARQLQuery()
+            child.pg_type = PGType.UNION
+            child.pattern_group = sub_pg
+            child.result.nvars = q.result.nvars
+            child.result.blind = False
+            self._execute_inner(child)
+            if sub_pg.filters:  # branch-level FILTERs run host-side per branch
+                assert_ec(self.str_server is not None, ErrorCode.UNKNOWN_FILTER,
+                          "FILTER needs a string server")
+                host._execute_filters(child)
+            merged = host._merge_union(merged, child.result, q.result.nvars)
+        q.result.v2c_map = merged.v2c_map
+        q.result.col_num = merged.col_num
+        q.result.set_table(merged.table)
+        q.union_done = True
 
     # ------------------------------------------------------------------
     # plan building (host): pattern list -> step descriptors with capacities
