@@ -137,7 +137,9 @@ class DeviceStore:
         self.device = device or jax.devices()[0]
         self.budget = budget_bytes
         self._cache: dict = {}  # (pid, dir) -> DeviceSegment
-        self._index_cache: dict = {}  # (tpid, dir) -> (jnp array, real_len)
+        self._index_cache: dict = {}  # ("idx", tpid, dir) -> (jnp arr, real_len)
+        #   (the "idx" prefix keeps index keys distinct from segment (pid, dir)
+        #    keys in the shared LRU/pin bookkeeping)
         self._lru: list = []
         self._pinned: set = set()
         self.bytes_used = 0
@@ -176,8 +178,9 @@ class DeviceStore:
     def index_list(self, tpid: int, d: int):
         """Index edge list (type members / pred subjects-objects) on device."""
         self._check_version()
-        key = (int(tpid), int(d))
+        key = ("idx", int(tpid), int(d))
         if key in self._index_cache:
+            self._touch(key)
             return self._index_cache[key]
         import jax.numpy as jnp
 
@@ -186,9 +189,12 @@ class DeviceStore:
         padded = np.full(pad, INT32_MAX, dtype=np.int32)
         padded[: len(arr)] = arr
         dev = jnp.asarray(padded)
-        self._index_cache[key] = (dev, len(arr))
+        entry = (dev, len(arr))
+        self._index_cache[key] = entry
+        self._lru.append(key)
         self.bytes_used += dev.size * 4
-        return self._index_cache[key]
+        self._enforce_budget()
+        return entry
 
     def _build_type_index_csr(self) -> DeviceSegment | None:
         """Type membership as one CSR keyed by type id (subject-side tidx)."""
@@ -232,12 +238,16 @@ class DeviceStore:
                 self._evict(victim)
 
     def _evictable(self):
-        return [k for k in self._lru if k not in self._pinned and k in self._cache]
+        return [k for k in self._lru if k not in self._pinned
+                and (k in self._cache or k in self._index_cache)]
 
     def _evict(self, key) -> None:
-        seg = self._cache.pop(key)
+        if key in self._cache:
+            self.bytes_used -= self._cache.pop(key).nbytes
+        else:
+            dev, _ = self._index_cache.pop(key)
+            self.bytes_used -= dev.size * 4
         self._lru.remove(key)
-        self.bytes_used -= seg.nbytes
 
     def _touch(self, key) -> None:
         if key in self._lru:

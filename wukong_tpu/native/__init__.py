@@ -11,6 +11,7 @@ implementation when the toolchain or the .so is unavailable.
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import subprocess
 
@@ -19,6 +20,7 @@ import numpy as np
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "wukong_native.cpp")
 _SO = os.path.join(_DIR, "libwukong_native.so")
+_STAMP = _SO + ".srchash"
 
 _lib = None
 _tried = False
@@ -41,14 +43,24 @@ def get_lib():
         return _lib
     _tried = True
     try:
-        if not os.path.exists(_SO) or \
-                os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+        # Rebuild gated on a source-content hash (git does not preserve
+        # mtimes, and a committed/stale binary must never be trusted over
+        # the source it claims to come from).
+        with open(_SRC, "rb") as f:
+            src_hash = hashlib.sha256(f.read()).hexdigest()
+        stale = True
+        if os.path.exists(_SO) and os.path.exists(_STAMP):
+            with open(_STAMP) as f:
+                stale = f.read().strip() != src_hash
+        if stale:
             cc = _compiler()
             if cc is None:
                 return None
             subprocess.run(
                 [cc, "-O3", "-shared", "-fPIC", _SRC, "-o", _SO],
                 check=True, capture_output=True)
+            with open(_STAMP, "w") as f:
+                f.write(src_hash)
         lib = ctypes.CDLL(_SO)
         lib.parse_id_triples.restype = ctypes.c_long
         lib.parse_id_triples.argtypes = [

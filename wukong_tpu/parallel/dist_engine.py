@@ -75,6 +75,10 @@ class DistEngine:
 
     # ------------------------------------------------------------------
     def execute(self, q: SPARQLQuery, from_proxy: bool = True) -> SPARQLQuery:
+        if self.sstore.check_version():
+            # compiled chains bake per-segment max_probe/depth — stale after
+            # dynamic inserts (dynamic_gstore.hpp lease invalidation analogue)
+            self._fn_cache.clear()
         try:
             self._execute_inner(q)
             # FILTER/FINAL run host-side on the gathered table (they touch
@@ -280,19 +284,26 @@ class DistEngine:
     # compiled chain per plan signature
     # ------------------------------------------------------------------
     def _get_fn(self, plan: _Plan):
-        sig = plan.signature()
-        # gather the device arrays each step needs (also the call args)
+        # gather the device arrays each step needs (also the call args);
+        # per-step (max_probe, max_deg_log2) join the cache key because the
+        # compiled chain bakes them in as constants — a restaged segment
+        # (dynamic insert) must never reuse a chain with smaller bounds
+        bounds = []
         args = []
         for s in plan.steps:
             if s.kind == "init_index":
                 idx = self.sstore.index_list(s.pid, s.dir)
                 args.append((idx.edges, self._real_lens_arr(idx)))
+                bounds.append((0, 0))
             else:
                 seg = self.sstore.segment(s.pid, s.dir)
                 if seg is None:
                     args.append(None)
+                    bounds.append((0, 0))
                 else:
                     args.append((seg.bkey, seg.bstart, seg.bdeg, seg.edges))
+                    bounds.append((seg.max_probe, seg.max_deg_log2))
+        sig = (plan.signature(), tuple(bounds))
         if sig in self._fn_cache:
             return self._fn_cache[sig], self._flatten_args(args)
         fn = self._compile(plan, args)

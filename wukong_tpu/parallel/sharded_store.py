@@ -53,6 +53,25 @@ class ShardedDeviceStore:
         self._cache: dict = {}
         self._index_cache: dict = {}
         self.bytes_used = 0
+        self._seen_version = self.version()
+
+    def version(self) -> int:
+        """Max dynamic-insert version across all partitions."""
+        return max((getattr(g, "version", 0) for g in self.stores), default=0)
+
+    def check_version(self) -> bool:
+        """Drop stale stagings after dynamic inserts (mirrors the single-chip
+        DeviceStore._check_version). Returns True when caches were invalidated
+        so the engine can also drop compiled plans whose baked-in probe/depth
+        bounds came from the old segments."""
+        v = self.version()
+        if v != self._seen_version:
+            self._cache.clear()
+            self._index_cache.clear()
+            self.bytes_used = 0
+            self._seen_version = v
+            return True
+        return False
 
     def _put(self, arr: np.ndarray):
         import jax
@@ -63,6 +82,7 @@ class ShardedDeviceStore:
 
     # ------------------------------------------------------------------
     def segment(self, pid: int, d: int) -> StackedSegment | None:
+        self.check_version()
         key = (int(pid), int(d))
         if key in self._cache:
             return self._cache[key]
@@ -123,6 +143,7 @@ class ShardedDeviceStore:
 
     # ------------------------------------------------------------------
     def index_list(self, tpid: int, d: int) -> StackedIndex:
+        self.check_version()
         key = (int(tpid), int(d))
         if key in self._index_cache:
             return self._index_cache[key]

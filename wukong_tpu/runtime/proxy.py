@@ -158,10 +158,8 @@ class Proxy:
         if self.dist is not None:
             targets += [g for g in self.dist.sstore.stores if g is not self.g]
         n = load_dir_into(targets, dirname, dedup=check_dup)
-        if self.dist is not None:
-            # sharded device arrays are rebuilt lazily from the bumped stores
-            self.dist.sstore._cache.clear()
-            self.dist.sstore._index_cache.clear()
+        if self.dist is not None and self.dist.sstore.check_version():
+            # compiled chains bake per-segment probe/depth bounds
             self.dist._fn_cache.clear()
         log_info(f"dynamic load: {n:,} new subject-side edges from {dirname}")
 

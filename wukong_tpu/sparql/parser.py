@@ -246,11 +246,25 @@ class Parser:
                 self._next()
                 group["filters"].append(self._parse_filter_expr())
                 continue
-            # triple pattern
+            # triple pattern, with the ';' predicate-object-list and ','
+            # object-list shorthand (SPARQLParser.hpp:771-809 parseGraphPattern)
             s = self._parse_term()
             p = self._parse_term(predicate=True)
             o = self._parse_term()
             group["patterns"].append((s, p, o))
+            while self._peek()[1] in (";", ","):
+                sep = self._next()[1]
+                if sep == ";":
+                    nk, nv = self._peek()
+                    # trailing ';' may be followed by '.', '}', another group
+                    # element, or more ';' (SPARQL PropertyListNotEmpty)
+                    if nv in (";", ".", "}", "{") or (
+                            nk == "KEYWORD"
+                            and nv.upper() in ("FILTER", "OPTIONAL")):
+                        continue
+                    p = self._parse_term(predicate=True)
+                o = self._parse_term()
+                group["patterns"].append((s, p, o))
             if self._peek()[1] == ".":
                 self._next()
         return group

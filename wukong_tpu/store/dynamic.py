@@ -22,12 +22,17 @@ from wukong_tpu.types import IN, NORMAL_ID_START, OUT, TYPE_ID
 from wukong_tpu.utils.mathutil import hash_mod
 
 
-def insert_triples(g: GStore, triples: np.ndarray, dedup: bool = True) -> int:
+def insert_triples(g: GStore, triples: np.ndarray, dedup: bool = True,
+                   check_ids: bool = True) -> int:
     """Insert an [N,3] batch into this partition. Returns #edges inserted
     (subject-side copies; the object-side copies are inserted symmetrically).
 
     Bumps g.version so device caches restage affected segments.
     """
+    if check_ids:
+        from wukong_tpu.store.gstore import check_vid_range
+
+        check_vid_range(triples)
     s, p, o = triples[:, 0], triples[:, 1], triples[:, 2]
     n = g.num_workers
     mine_out = hash_mod(s, n) == g.sid
@@ -103,8 +108,11 @@ def load_dir_into(stores: list[GStore], dirname: str, dedup: bool = True) -> int
     (the RDFEngine::execute_load_data path, core/engine/rdf.hpp)."""
     from wukong_tpu.loader.base import load_triples
 
+    from wukong_tpu.store.gstore import check_vid_range
+
     triples = load_triples(dirname)
+    check_vid_range(triples)  # once, not per store
     total = 0
     for g in stores:
-        total += insert_triples(g, triples, dedup)
+        total += insert_triples(g, triples, dedup, check_ids=False)
     return total

@@ -119,6 +119,20 @@ def owner_of_subject(s: np.ndarray, n: int) -> np.ndarray:
     return hash_mod(s, n)
 
 
+def check_vid_range(triples: np.ndarray) -> None:
+    """Device staging narrows ids to int32 (types.py documents the <2^31
+    assumption), and INT32_MAX itself is the device-side padding/dead-row
+    sentinel — so ids must stay strictly below 2^31 - 1 or they wrap/collide
+    silently into wrong query results."""
+    if len(triples) and int(triples.max()) >= 2**31 - 1:
+        from wukong_tpu.utils.errors import ErrorCode, WukongError
+
+        raise WukongError(
+            ErrorCode.UNKNOWN_PATTERN,
+            f"vertex id {int(triples.max())} >= 2^31 - 1: ids no longer fit "
+            "the int32 device representation (see types.py)")
+
+
 def _triple_argsort(primary, secondary, tertiary) -> np.ndarray:
     """argsort by (primary, secondary, tertiary) — native radix when available
     (the loader's sorted-run preparation, base_loader.hpp sorts)."""
@@ -142,7 +156,8 @@ def _pred_runs(p_sorted: np.ndarray, k_sorted: np.ndarray, v_sorted: np.ndarray)
 
 
 def build_partition(triples: np.ndarray, sid: int, num_workers: int,
-                    attr_triples=None, versatile: bool = True) -> GStore:
+                    attr_triples=None, versatile: bool = True,
+                    check_ids: bool = True) -> GStore:
     """Build worker `sid`'s GStore from the full [M,3] triple array.
 
     The reference reaches the same state via the loader's RDMA shuffle + sorted
@@ -150,6 +165,8 @@ def build_partition(triples: np.ndarray, sid: int, num_workers: int,
     selection + CSR building are vectorized numpy over the shared array.
     """
     g = GStore(sid=sid, num_workers=num_workers)
+    if check_ids:
+        check_vid_range(triples)
     s, p, o = triples[:, 0], triples[:, 1], triples[:, 2]
     mine_out = hash_mod(s, num_workers) == sid  # pso copy (subject owner)
     mine_in = hash_mod(o, num_workers) == sid  # pos copy (object owner)
@@ -214,5 +231,7 @@ def build_partition(triples: np.ndarray, sid: int, num_workers: int,
 
 def build_all_partitions(triples: np.ndarray, num_workers: int,
                          attr_triples=None, versatile: bool = True) -> list[GStore]:
-    return [build_partition(triples, i, num_workers, attr_triples, versatile)
+    check_vid_range(triples)  # once, not per partition
+    return [build_partition(triples, i, num_workers, attr_triples, versatile,
+                            check_ids=False)
             for i in range(num_workers)]
