@@ -143,3 +143,45 @@ def test_stats_capacity_estimation_reduces_retries(world):
         K.expand = orig
     assert q.result.nrows == q2.result.nrows
     assert with_stats <= without  # stats never add retries
+
+
+HEAVIES = [f"{BASIC}/lubm_q{k}" for k in (1, 2, 3, 7)]
+
+
+@pytest.mark.parametrize("qfile", HEAVIES,
+                         ids=[os.path.basename(f) for f in HEAVIES])
+def test_batch_index_replicate_and_slice(engines, world, qfile):
+    """Batched index-origin (heavy) execution: every replicated instance
+    reproduces the single-query count; slices partition it."""
+    cpu, tpu = engines
+    _, ss = world
+    text = open(qfile).read()
+
+    q = Parser(ss).parse(text)
+    heuristic_plan(q)
+    cpu.execute(q)
+    assert q.result.status_code == 0
+    want = q.result.nrows
+
+    B = 4
+    qb = Parser(ss).parse(text)
+    heuristic_plan(qb)
+    qb.result.blind = True
+    counts = tpu.execute_batch_index(qb, B)
+    assert counts.shape == (B,)
+    assert counts.tolist() == [want] * B
+
+    qs = Parser(ss).parse(text)
+    heuristic_plan(qs)
+    qs.result.blind = True
+    counts = tpu.execute_batch_index(qs, B, slice_mode=True)
+    assert int(counts.sum()) == want
+
+
+def test_suggest_index_batch(engines, world):
+    _, tpu = engines
+    _, ss = world
+    q = Parser(ss).parse(open(f"{BASIC}/lubm_q2").read())
+    heuristic_plan(q)
+    b = tpu.suggest_index_batch(q)
+    assert 1 <= b <= 1024

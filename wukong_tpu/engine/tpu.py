@@ -254,10 +254,9 @@ class TPUEngine:
                           "batch steps must anchor on a bound column")
             probe.bind(pat)
         B = len(consts)
-        cap_override: dict[int, int] = {}
-        for _attempt in range(8):
-            state = _ChainState(q.result)
-            # init: [B, 2] — col0 qid, col1 the per-instance start constant
+
+        def make_init(state: "_ChainState", cap_override: dict) -> int:
+            # init: [2, cap] — row 0 qid, row 1 the per-instance start constant
             cap0 = K.next_capacity(B, self.cap_min)
             init = np.zeros((2, cap0), dtype=np.int32)  # [width, capacity]
             init[0, :B] = np.arange(B)
@@ -267,49 +266,141 @@ class TPUEngine:
             state.width = 2
             state.cols[pats[0].subject] = 1  # start consts act as a known col
             state.est_rows = B
-            for k in range(len(pats)):
-                pat = q.get_pattern(k)
-                anchor = state.col_of(pat.subject)
-                self._dispatch_one(q, pat, k, state, cap_override,
-                                   anchor_col=anchor)
-            counts = _qid_counts(state.table, state.n, B)
-            payload = (counts, [t for (_, t, _) in state.totals])
-            host_counts, totals = jax.device_get(payload)
-            over = False
-            for (s, _, c), t in zip(state.totals, totals):
-                if int(t) > c:
-                    if int(t) > self.cap_max:
-                        raise WukongError(
-                            ErrorCode.UNKNOWN_PATTERN,
-                            f"batch intermediate ({int(t):,} rows) exceeds "
-                            f"table_capacity_max ({self.cap_max:,})")
-                    cap_override[s] = K.next_capacity(int(t), self.cap_min,
-                                                      self.cap_max)
-                    over = True
-            if not over:
-                return np.asarray(host_counts)
-        raise WukongError(ErrorCode.UNKNOWN_PATTERN,
-                          "batch capacity retry limit exceeded")
+            return 0  # dispatch every pattern (the const col pre-binds step 0)
+
+        return self._run_batch_chain(q, B, make_init)
+
+    def execute_batch_index(self, q: SPARQLQuery, B: int,
+                            slice_mode: bool = False) -> np.ndarray:
+        """Batched execution of an index-origin (heavy) query.
+
+        replicate mode: B independent full instances — the qid dimension
+        amortizes the end-of-chain device sync across B queries (the
+        reference's 'at batch' heavy throughput). slice mode: the index scan
+        is split into B contiguous slices (qid = slice), the single-chip
+        analogue of fanning a heavy query out to num_servers x mt_factor
+        engines (sparql.hpp:98-108, 1064-1088); per-qid counts sum to the
+        query total. Returns per-qid result row counts (blind semantics).
+        """
+        import jax.numpy as jnp
+
+        pats = q.pattern_group.patterns
+        assert_ec(len(pats) > 0 and q.start_from_index()
+                  and _is_index_start(pats[0]) and pats[0].object < 0,
+                  ErrorCode.UNKNOWN_PLAN,
+                  "batch-index execution needs an index-origin start")
+        probe = _MetaResult(q.result)
+        probe.cols[pats[0].object] = 1
+        probe.width = 2
+        for k, pat in enumerate(pats):
+            assert_ec(pat.pred_type == int(AttrType.SID_t) and pat.predicate >= 0,
+                      ErrorCode.UNKNOWN_PATTERN,
+                      "batch steps must have const SID predicates")
+            if k > 0:
+                assert_ec(probe.col_of(pat.subject) is not None,
+                          ErrorCode.UNKNOWN_PATTERN,
+                          "batch steps must anchor on a bound column")
+                probe.bind(pat)
+        edges, real = self.dstore.index_list(pats[0].subject, pats[0].direction)
+        total0 = real if slice_mode else real * B
+        assert_ec(total0 <= self.cap_max, ErrorCode.UNKNOWN_PATTERN,
+                  f"batch-index start ({total0:,} rows) exceeds "
+                  f"table_capacity_max ({self.cap_max:,})")
+
+        def make_init(state: "_ChainState", cap_override: dict) -> int:
+            # total0 <= cap_max was asserted above, so cap0 always suffices
+            # (the init step does not participate in the overflow-retry loop)
+            cap0 = K.next_capacity(
+                max(total0, 1), self.cap_min, self.cap_max)
+            state.table, state.n = K.init_batch_index(
+                edges, jnp.int32(real), B=B, cap=cap0, slice_mode=slice_mode)
+            state.width = 2
+            state.cols[pats[0].object] = 1
+            state.est_rows = max(total0, 1)
+            return 1  # pattern 0 is consumed by the init
+
+        return self._run_batch_chain(q, B, make_init)
+
+    def _run_batch_chain(self, q: SPARQLQuery, B: int, make_init) -> np.ndarray:
+        import jax
+
+        pats = q.pattern_group.patterns
+        pins = [(p.predicate, p.direction) for p in pats if p.predicate > 0]
+        self.dstore.pin(pins)
+        try:
+            cap_override: dict[int, int] = {}
+            for _attempt in range(8):
+                state = _ChainState(q.result)
+                first = make_init(state, cap_override)
+                for k in range(first, len(pats)):
+                    pat = q.get_pattern(k)
+                    anchor = state.col_of(pat.subject)
+                    self._dispatch_one(q, pat, k, state, cap_override,
+                                       anchor_col=anchor)
+                counts = _qid_counts(state.table, state.n, B)
+                payload = (counts, [t for (_, t, _) in state.totals])
+                host_counts, totals = jax.device_get(payload)
+                over = False
+                for (s, _, c), t in zip(state.totals, totals):
+                    if int(t) > c:
+                        if int(t) > self.cap_max:
+                            raise WukongError(
+                                ErrorCode.UNKNOWN_PATTERN,
+                                f"batch intermediate ({int(t):,} rows) exceeds "
+                                f"table_capacity_max ({self.cap_max:,})")
+                        cap_override[s] = K.next_capacity(int(t), self.cap_min,
+                                                          self.cap_max)
+                        over = True
+                if not over:
+                    return np.asarray(host_counts)
+            raise WukongError(ErrorCode.UNKNOWN_PATTERN,
+                              "batch capacity retry limit exceeded")
+        finally:
+            self.dstore.unpin(pins)
+
+    def suggest_index_batch(self, q: SPARQLQuery, cap: int = 1024) -> int:
+        """Largest power-of-two B (<= cap) whose replicated batch is estimated
+        to fit the capacity ceiling at every chain step."""
+        pats = q.pattern_group.patterns
+        if not pats or not q.start_from_index():
+            return 1
+        peak = est = max(len(self.g.get_index(pats[0].subject,
+                                              pats[0].direction)), 1)
+        for pat in pats[1:]:
+            if pat.object < 0:  # expansions grow; member steps only shrink
+                est = int(est * self._fanout(pat)) or 1
+                peak = max(peak, est)
+        B = 1
+        while B < cap and 2 * B * peak <= self.cap_max // 2:
+            B *= 2
+        return B
+
+    def _fanout(self, pat, seg=None) -> float:
+        """Per-row expansion factor estimate — the single source for both
+        capacity estimation (_estimate_rows) and batch sizing, so the two
+        can never drift. Stats-based when available (pred edges / anchor
+        population, x1.5 safety), else segment average degree x2."""
+        if self.stats is not None:
+            pe = self.stats.pred_edges.get(pat.predicate)
+            if pe:
+                anchors = (self.stats.distinct_subj if pat.direction == OUT
+                           else self.stats.distinct_obj
+                           ).get(pat.predicate, 0) or 1
+                return pe / anchors * 1.5
+        if seg is not None:
+            return max(1.0, seg.num_edges / max(seg.num_keys, 1)) * 2
+        host = self.g.segments.get((pat.predicate, pat.direction))
+        if host is None:
+            return 1.0
+        return max(1.0, host.num_edges / max(len(host.keys), 1)) * 2
 
     # ------------------------------------------------------------------
     def _estimate_rows(self, state, pat, seg) -> int:
         """Expected output rows of an expansion step.
 
-        With planner statistics: anchor-population-weighted fanout from
-        fine_type (rows * sum(fanout)/anchors, x1.5 safety). Without: segment
-        average degree x2. Both round up to a capacity class; a wrong estimate
-        costs one chain retry, never correctness."""
-        avg_deg = max(1.0, seg.num_edges / max(seg.num_keys, 1))
-        fallback = int(min(state.est_rows * avg_deg * 2, self.cap_max))
-        if self.stats is None:
-            return fallback
-        st = self.stats
-        pe = st.pred_edges.get(pat.predicate)
-        if not pe:
-            return fallback
-        anchors = (st.distinct_subj if pat.direction == OUT
-                   else st.distinct_obj).get(pat.predicate, 0) or 1
-        est = int(min(state.est_rows * (pe / anchors) * 1.5, self.cap_max))
+        Uses the shared _fanout estimate; rounds up to a capacity class. A
+        wrong estimate costs one chain retry, never correctness."""
+        est = int(min(state.est_rows * self._fanout(pat, seg), self.cap_max))
         return max(est, 1)
 
     # ------------------------------------------------------------------

@@ -172,6 +172,36 @@ def init_from_list(edge_list, real_len, cap):
     return table, jnp.minimum(real_len, cap).astype(jnp.int32)
 
 
+@partial(jax.jit, static_argnames=("B", "cap", "slice_mode"))
+def init_batch_index(edge_list, real_len, B, cap, slice_mode):
+    """Batched index-origin start: [2, cap] table with a qid row.
+
+    replicate mode (slice_mode=False): B full copies of the index list —
+    B independent instances of the query (throughput batching; amortizes the
+    end-of-chain sync across B queries).
+    slice mode (slice_mode=True): the index split into B contiguous slices,
+    qid = slice id — the reference's mt_factor index-scan slicing
+    (sparql.hpp:98-108) as a batch dimension; per-qid counts sum to the
+    full query's total.
+    """
+    j = jnp.arange(cap, dtype=jnp.int32)
+    E = edge_list.shape[0]
+    if slice_mode:
+        per = jnp.maximum((real_len + B - 1) // B, 1)
+        qid = jnp.minimum(j // per, B - 1)
+        pos = j
+        total = real_len
+    else:
+        r = jnp.maximum(real_len, 1)
+        qid = j // r
+        pos = j - qid * r
+        total = real_len * B
+    vals = edge_list[jnp.clip(pos, 0, E - 1)]
+    valid = j < total
+    table = jnp.stack([jnp.where(valid, qid, 0), jnp.where(valid, vals, 0)])
+    return table, jnp.minimum(total, cap).astype(jnp.int32)
+
+
 @partial(jax.jit, static_argnames=("col",))
 def member_mask_list(table, n, col, sorted_list, real_len):
     """index_to_known / const_to_known: membership of a row in a sorted list."""
