@@ -47,23 +47,34 @@ def _geomean(xs):
 
 
 def _ensure_world(scale: int):
-    from wukong_tpu.loader.lubm import VirtualLubmStrings, generate_lubm
+    from wukong_tpu.loader.lubm import (
+        DATASET_VERSION,
+        VirtualLubmStrings,
+        generate_lubm,
+    )
     from wukong_tpu.store.gstore import build_partition
     from wukong_tpu.store.persist import load_gstore, save_gstore
 
     from wukong_tpu.planner.stats import Stats
 
     os.makedirs(CACHE, exist_ok=True)
-    store_path = os.path.join(CACHE, f"lubm{scale}_p0.npz")
-    stats_path = os.path.join(CACHE, f"lubm{scale}_stats.npz")
+    v = f"v{DATASET_VERSION}"
+    store_path = os.path.join(CACHE, f"lubm{scale}_{v}_p0.npz")
+    stats_path = os.path.join(CACHE, f"lubm{scale}_{v}_stats.npz")
     ss = VirtualLubmStrings(scale, seed=0)
     triples = None
 
     def load_tri():
-        tri_path = os.path.join(REPO, f".cache_lubm{scale}_triples.npy")
+        tri_path = os.path.join(REPO, f".cache_lubm{scale}_{v}_triples.npy")
         if os.path.exists(tri_path):
             return np.asarray(np.load(tri_path, mmap_mode="r"))
-        return generate_lubm(scale, seed=0)[0]
+        tri = generate_lubm(scale, seed=0)[0]
+        if scale >= 640:  # cache the multi-minute generation
+            try:
+                np.save(tri_path, tri)
+            except Exception as e:
+                print(f"# triples cache save failed: {e}", file=sys.stderr)
+        return tri
 
     if os.path.exists(store_path):
         g = load_gstore(store_path)
@@ -127,9 +138,13 @@ def main():
         jax.config.update("jax_platforms", "cpu")
     scale = int(os.environ.get("WUKONG_BENCH_SCALE", "0"))
     if scale == 0:
+        from wukong_tpu.loader.lubm import DATASET_VERSION
+
+        v = f"v{DATASET_VERSION}"
         scale = 2560 if (
-            os.path.exists(os.path.join(CACHE, "lubm2560_p0.npz"))
-            or os.path.exists(os.path.join(REPO, ".cache_lubm2560_triples.npy"))
+            os.path.exists(os.path.join(CACHE, f"lubm2560_{v}_p0.npz"))
+            or os.path.exists(
+                os.path.join(REPO, f".cache_lubm2560_{v}_triples.npy"))
         ) else 160
     if not device_ok and scale > 40:
         print(f"# cpu-fallback: clamping scale {scale} -> 40 "

@@ -69,7 +69,7 @@ def test_attr_known_to_unknown(world, monkeypatch):
     from wukong_tpu.loader.lubm import P
     from wukong_tpu.types import IN
 
-    by_s = {s: v for (s, a, t, v) in attrs}
+    by_s = {s: v for (s, a, t, v) in attrs if a == A["age"]}
     members = g.get_triples(int(lay.dept_id[0]), P["memberOf"], IN)
     want = sorted(v for m in members if (v := by_s.get(int(m))) is not None)
     got = sorted(int(r[0]) for r in q.result.attr_table)

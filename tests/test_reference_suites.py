@@ -73,5 +73,5 @@ def test_union_suite_counts(world):
     from wukong_tpu.types import IN
 
     n_course = len(g.get_index(T["Course"], IN))
-    n_univ_named = 0  # universities have no name literals in our generator
+    n_univ_named = len(g.get_index(T["University"], IN))  # all have names
     assert q.result.nrows == n_course + n_univ_named

@@ -646,6 +646,10 @@ class CPUEngine:
             self._general_filter(f.arg1, res, k1)
             self._general_filter(f.arg2, res, k2)
             keep &= k1 | k2
+        elif f.type == FilterType.Not:
+            k1 = np.ones(len(keep), dtype=bool)
+            self._general_filter(f.arg1, res, k1)
+            keep &= ~k1
         elif f.type in (FilterType.Equal, FilterType.NotEqual, FilterType.Less,
                         FilterType.LessOrEqual, FilterType.Greater,
                         FilterType.GreaterOrEqual):

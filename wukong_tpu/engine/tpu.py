@@ -366,10 +366,14 @@ class TPUEngine:
             return 1
         peak = est = max(len(self.g.get_index(pats[0].subject,
                                               pats[0].direction)), 1)
+        bound = {pats[0].object}
         for pat in pats[1:]:
-            if pat.object < 0:  # expansions grow; member steps only shrink
+            if pat.object < 0 and pat.object not in bound \
+                    and pat.subject in bound:
+                # a genuine expansion; member/k2k steps only shrink
                 est = int(est * self._fanout(pat)) or 1
                 peak = max(peak, est)
+                bound.add(pat.object)
         B = 1
         while B < cap and 2 * B * peak <= self.cap_max // 2:
             B *= 2

@@ -51,12 +51,16 @@ T = {name: 2 + len(PRED_NAMES) + i for i, name in enumerate(TYPE_NAMES)}
 
 # attribute predicates (typed literals — datagen/add_attribute.cpp analogue):
 # id space continues after types; value types per utils/variant.hpp tags
-ATTR_NAMES = [("age", 1)]  # (name, INT_t)
+ATTR_NAMES = [("age", 1), ("id", 1)]  # (name, INT_t)
 A = {name: 2 + len(PRED_NAMES) + len(TYPE_NAMES) + i
      for i, (name, _t) in enumerate(ATTR_NAMES)}
 ATTR_TYPE = {A[name]: t for (name, t) in ATTR_NAMES}
 
 NUM_RESEARCH = 30  # researchInterest literal pool ("Research0".."Research29")
+
+# Bump when the synthesized dataset changes shape/ids — cache files
+# (bench.py .cache/) are keyed on it so stale stores are never reused.
+DATASET_VERSION = 2
 
 FACULTY_CLASSES = ["FullProfessor", "AssociateProfessor", "AssistantProfessor", "Lecturer"]
 
@@ -152,6 +156,19 @@ def _faculty_rank(n_fp, n_ap, n_assi, n_lec) -> np.ndarray:
     return np.repeat(np.tile(np.arange(4), D), per_dept.reshape(-1))
 
 
+def _faculty_rank_local(c: "LubmCounts") -> np.ndarray:
+    """[F_total] index within each (dept, rank) segment — the digits of each
+    faculty member's name literal. Single source for name emission AND the
+    ub:id attribute value, so the two can never drift."""
+    return _seg_local_index(
+        np.stack([c.n_fp, c.n_ap, c.n_assi, c.n_lec], 1).reshape(-1))
+
+
+def _dept_local(c: "LubmCounts") -> np.ndarray:
+    """[D] department index local to its university ("Department{j}")."""
+    return _seg_local_index(c.ndept)
+
+
 # ---------------------------------------------------------------------------
 # ID layout
 # ---------------------------------------------------------------------------
@@ -191,8 +208,13 @@ def lubm_layout(c: LubmCounts) -> LubmLayout:
     research_base = cur
     cur += NUM_RESEARCH
     # shared name-literal pools, sized by the max per-dept count of each class
+    # ("University{u}" / "Department{j}" names are emitted too — the UBA
+    # generator gives every org a name, and the reference optional/union
+    # suites look "University0" up by literal)
     name_pool_base, name_pool_size = {}, {}
     pools = {
+        "University": int(c.n_univ),
+        "Department": int(c.ndept.max()),
         "FullProfessor": int(c.n_fp.max()),
         "AssociateProfessor": int(c.n_ap.max()),
         "AssistantProfessor": int(c.n_assi.max()),
@@ -278,10 +300,13 @@ def generate_lubm(n_univ: int, seed: int = 0):
     # universities
     univs = lay.univ_base + np.arange(n_univ)
     emit(univs, TYPE_ID, np.full(n_univ, T["University"]))
+    emit(univs, P["name"], lay.name_pool_base["University"] + np.arange(n_univ))
 
-    # departments
+    # departments ("Department{j}" with j local to the university)
     emit(lay.dept_id, TYPE_ID, np.full(D, T["Department"]))
     emit(lay.dept_id, P["subOrganizationOf"], univ_of_dept)
+    emit(lay.dept_id, P["name"],
+         lay.name_pool_base["Department"] + _dept_local(c))
 
     # faculty
     rank_type = np.array([T[x] for x in FACULTY_CLASSES])[fac_rank]
@@ -292,9 +317,7 @@ def generate_lubm(n_univ: int, seed: int = 0):
     # head of department = first FullProfessor
     emit(lay.fac_base, P["headOf"], lay.dept_id)
     # name literal: "Class{k}" where k = rank-local index
-    rank_local = _seg_local_index(
-        np.stack([c.n_fp, c.n_ap, c.n_assi, c.n_lec], 1).reshape(-1)
-    )
+    rank_local = _faculty_rank_local(c)
     fac_name = np.array([lay.name_pool_base[x] for x in FACULTY_CLASSES])[fac_rank] + rank_local
     emit(fac_id, P["name"], fac_name)
     emit(fac_id, P["emailAddress"], lay.email_base[dept_of_fac] + _seg_local_index(n_fac))
@@ -384,16 +407,40 @@ def generate_lubm(n_univ: int, seed: int = 0):
 
 
 def generate_lubm_attrs(n_univ: int, seed: int = 0) -> list[tuple]:
-    """Attribute triples (s, aid, type_tag, value): every undergraduate gets an
-    int `age` (the reference adds typed attrs via add_attribute.cpp)."""
+    """Attribute triples (s, aid, type_tag, value).
+
+    - every undergraduate gets an int `age`
+    - every named entity gets an int `id` = the digits of its name literal —
+      exactly what the reference's datagen/add_attribute.cpp:118-124 appends
+      for each ub:name triple (the attr suite queries ub:id)."""
     c = lubm_counts(n_univ, seed)
     lay = lubm_layout(c)
     rng = np.random.Generator(np.random.PCG64([seed, 2]))
-    dept_of_ug = np.repeat(np.arange(c.D), c.n_ug)
+    D = c.D
+    dept_of_ug = np.repeat(np.arange(D), c.n_ug)
     ug_id = lay.ug_base[dept_of_ug] + _seg_local_index(c.n_ug)
     ages = rng.integers(17, 24, len(ug_id))
-    aid = A["age"]
-    return [(int(v), aid, 1, int(a)) for v, a in zip(ug_id, ages)]
+    out = [(int(v), A["age"], 1, int(a)) for v, a in zip(ug_id, ages)]
+
+    aid = A["id"]
+
+    def add(ids, ks):
+        out.extend((int(v), aid, 1, int(k)) for v, k in zip(ids, ks))
+
+    add(lay.univ_base + np.arange(n_univ), np.arange(n_univ))
+    add(lay.dept_id, _dept_local(c))
+    n_fac = c.n_fac
+    dept_of_fac = np.repeat(np.arange(D), n_fac)
+    fac_id = lay.fac_base[dept_of_fac] + _seg_local_index(n_fac)
+    add(fac_id, _faculty_rank_local(c))
+    for base, sizes in ((lay.course_base, c.n_course),
+                        (lay.gcourse_base, c.n_gcourse),
+                        (lay.ug_base, c.n_ug),
+                        (lay.gs_base, c.n_gs),
+                        (lay.pub_base, c.n_pub)):
+        dept_of = np.repeat(np.arange(D), sizes)
+        add(base[dept_of] + _seg_local_index(sizes), _seg_local_index(sizes))
+    return out
 
 
 def _sample_courses(rng, student_id, dept_of_student, base, seg_size, lo, hi):

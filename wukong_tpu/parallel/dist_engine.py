@@ -35,7 +35,8 @@ from wukong_tpu.utils.errors import ErrorCode, WukongError, assert_ec
 
 @dataclass
 class _Step:
-    kind: str  # init_index | init_const | expand | expand_type_all | member
+    kind: str  # init_index | init_const | init_rows | expand
+    #           | expand_type_all | member
     pid: int = 0
     dir: int = 0
     col: int = -1  # anchor column
@@ -44,6 +45,7 @@ class _Step:
     cap: int = 0  # output capacity class (expansion / exchange target)
     exch_cap: int = 0  # per-destination exchange capacity (0 = no exchange)
     new_col: bool = False
+    width: int = 0  # init_rows: seed table width
 
 
 @dataclass
@@ -54,7 +56,8 @@ class _Plan:
 
     def signature(self):
         return tuple(
-            (s.kind, s.pid, s.dir, s.col, s.vals_col, s.const, s.cap, s.exch_cap)
+            (s.kind, s.pid, s.dir, s.col, s.vals_col, s.const, s.cap,
+             s.exch_cap, s.width)
             for s in self.steps)
 
 
@@ -80,23 +83,36 @@ class DistEngine:
             # dynamic inserts (dynamic_gstore.hpp lease invalidation analogue)
             self._fn_cache.clear()
         try:
-            self._execute_inner(q)
-            # FILTER/FINAL run host-side on the gathered table (they touch
-            # strings and projections, not the graph). Top-level UNION runs
-            # branch-per-branch in _execute_inner; OPTIONAL stays unsupported
-            # in distributed v1
-            if q.pattern_group.filters or from_proxy:
-                assert_ec(self.str_server is not None or not
-                          (q.pattern_group.filters or q.orders),
-                          ErrorCode.UNKNOWN_FILTER,
-                          "FILTER/ORDER BY needs a string server")
-            if q.pattern_group.filters:
-                self._host()._execute_filters(q)
-            if from_proxy:
-                self._host()._final_process(q)
+            self._execute_sm(q, from_proxy)
         except WukongError as e:
             q.result.status_code = e.code
         return q
+
+    def _execute_sm(self, q: SPARQLQuery, from_proxy: bool) -> None:
+        """The distributed state machine: PATTERN -> UNION -> OPTIONAL ->
+        FILTER -> FINAL (sparql.hpp:1564-1673). BGPs run as compiled
+        shard_map chains; UNION branches and OPTIONAL groups run as seeded
+        distributed children; FILTER/FINAL run host-side on the gathered
+        table (they touch strings and projections, not the graph)."""
+        assert_ec(not (q.result.blind
+                       and (q.pattern_group.filters or q.pattern_group.unions
+                            or q.pattern_group.optional)),
+                  ErrorCode.UNSUPPORTED_SHAPE,
+                  "blind mode supports pure BGPs only (FILTER/UNION/OPTIONAL "
+                  "children need the gathered table)")
+        if q.has_pattern and not q.done_patterns():
+            self._execute_bgp(q)
+        if q.pattern_group.unions and not q.union_done:
+            self._execute_unions_dist(q)
+        while q.optional_step < len(q.pattern_group.optional):
+            self._execute_optional_dist(q)
+        if q.pattern_group.filters or (from_proxy and q.orders):
+            assert_ec(self.str_server is not None, ErrorCode.UNKNOWN_FILTER,
+                      "FILTER/ORDER BY needs a string server")
+        if q.pattern_group.filters:
+            self._host()._execute_filters(q)
+        if from_proxy:
+            self._host()._final_process(q)
 
     def _host(self):
         from wukong_tpu.engine.cpu import CPUEngine
@@ -105,25 +121,157 @@ class DistEngine:
             self._host_engine = CPUEngine(None, self.str_server)
         return self._host_engine
 
-    def _execute_inner(self, q: SPARQLQuery) -> None:
-        if q.pattern_group.unions and not q.has_pattern \
-                and not q.pattern_group.optional:
-            # top-level UNION: each branch is an independent distributed BGP;
-            # branch results merge host-side (Result::merge_result semantics)
-            self._execute_union_branches(q)
-            return
-        assert_ec(q.has_pattern, ErrorCode.UNKNOWN_PLAN, "no patterns")
-        if q.pattern_group.unions or q.pattern_group.optional:
-            raise WukongError(ErrorCode.UNSUPPORTED_SHAPE,
-                              "distributed engine v1 supports BGP(+FILTER) "
-                              "and top-level-UNION plans")
-        assert_ec(not (q.result.blind and q.pattern_group.filters),
-                  ErrorCode.UNSUPPORTED_SHAPE,
-                  "blind mode cannot evaluate FILTER phases")
+    def _attr_host(self):
+        """Host engine over the sharded attribute segments: an attr lookup
+        routes to the subject owner's partition — the reference executes attr
+        patterns CPU-side too (gpu_engine.hpp:267-333 unsupported on GPU)."""
+        from wukong_tpu.engine.cpu import CPUEngine
+
+        if not hasattr(self, "_attr_engine"):
+            self._attr_engine = CPUEngine(_ShardedAttrGraph(self.sstore.stores),
+                                          self.str_server)
+        return self._attr_engine
+
+    # ------------------------------------------------------------------
+    def _execute_bgp(self, q: SPARQLQuery) -> None:
+        """Device-supported prefix as one distributed chain; a trailing run of
+        attribute patterns executes host-side over the sharded attr stores."""
+        pats = q.pattern_group.patterns
+        split = q.pattern_step
+        while split < len(pats) and \
+                pats[split].pred_type == int(AttrType.SID_t):
+            split += 1
+        for pat in pats[split:]:  # the tail must be all-attr
+            assert_ec(pat.pred_type != int(AttrType.SID_t),
+                      ErrorCode.UNSUPPORTED_SHAPE,
+                      "SID patterns after attr patterns are unsupported "
+                      "in the distributed engine")
+        if split > q.pattern_step:
+            seed = None
+            if q.result.col_num > 0:  # seeded child (UNION branch on a table)
+                seed = (q.result.table, dict(q.result.v2c_map))
+            self._run_device_bgp(q, n_steps=split - q.pattern_step, seed=seed)
+        while not q.done_patterns():  # attr tail (or attr-only query)
+            self._attr_host()._execute_one_pattern(q)
+
+    def _execute_unions_dist(self, q: SPARQLQuery) -> None:
+        """Each UNION branch is a distributed child seeded with the parent's
+        result table (query.hpp:702-711 inherit_union); children recurse
+        through the full state machine, so nested UNION/OPTIONAL work."""
+        from wukong_tpu.sparql.ir import Result
+
+        assert_ec(q.result.attr_col_num == 0, ErrorCode.UNSUPPORT_UNION)
+        q.union_done = True
+        merged = None
+        host = self._host()
+        for sub_pg in q.pattern_group.unions:
+            child = SPARQLQuery()
+            child.pqid = q.qid
+            child.pg_type = PGType.UNION
+            child.pattern_group = sub_pg
+            # children rebind result state rather than mutate it, so the
+            # parent table is shared by reference (no deepcopy of rows)
+            child.result = Result(q.result.nvars)
+            child.result.v2c_map = dict(q.result.v2c_map)
+            child.result.col_num = q.result.col_num
+            child.result.table = q.result.table
+            child.result.nrows = q.result.nrows
+            child.result.blind = False
+            self._execute_sm(child, from_proxy=False)
+            if child.result.status_code != ErrorCode.SUCCESS:
+                raise WukongError(child.result.status_code,
+                                  "union child failed")
+            merged = host._merge_union(merged, child.result, q.result.nvars)
+        q.result.v2c_map = merged.v2c_map
+        q.result.col_num = merged.col_num
+        q.result.set_table(merged.table)
+
+    def _execute_optional_dist(self, q: SPARQLQuery) -> None:
+        """OPTIONAL as a dedup-seeded distributed child + host left join.
+
+        The reference masks rows in place (optional_matched_rows,
+        query.hpp:782-813); a left join over the shared bound variables is
+        the same relation: parent rows extend by every child match, rows
+        with no match survive with BLANK_ID in the group's new columns."""
+        import copy
+
+        from wukong_tpu.sparql.ir import NO_RESULT as NR
+        from wukong_tpu.types import BLANK_ID
+
+        group = q.pattern_group.optional[q.optional_step]
+        q.optional_step += 1
+        res = q.result
+        assert_ec(res.attr_col_num == 0, ErrorCode.UNSUPPORTED_SHAPE,
+                  "OPTIONAL after attribute patterns is unsupported "
+                  "in the distributed engine")
+        pg = copy.deepcopy(group)
+        host = self._host()
+        host._count_optional_new_vars(pg, res)
+        host._reorder_optional_patterns(pg, res)
+        # the reference evaluates an OPTIONAL group's FILTERs on the child's
+        # MERGED table (the child query re-enters the state machine with the
+        # parent rows, cpu.py _execute_optional) — a failing filter drops the
+        # whole row, matched or BLANK. So filters run after the join here.
+        deferred_filters = pg.filters
+        pg.filters = []
+
+        # join keys = parent-bound vars used by the group's PATTERNS; the
+        # deferred filters see every parent column on the joined table, so
+        # filter-only vars never need seeding
+        used = {v for p in pg.patterns for v in (p.subject, p.object) if v < 0}
+        shared = sorted({v for v in used if res.var2col(v) != NR},
+                        reverse=True)
+        assert_ec(len(shared) > 0, ErrorCode.UNSUPPORTED_SHAPE,
+                  "OPTIONAL group shares no bound variable with its parent")
+        pcols = [res.var2col(v) for v in shared]
+        seeds = (np.unique(res.table[:, pcols], axis=0)
+                 if res.table.size else np.empty((0, len(pcols)), np.int64))
+
+        child = SPARQLQuery()
+        child.pqid = q.qid
+        child.pattern_group = pg
+        child.result.nvars = res.nvars
+        child.result.set_table(seeds.astype(np.int64))
+        child.result.col_num = len(pcols)
+        for i, v in enumerate(shared):
+            child.result.add_var2col(v, i)
+        child.result.blind = False
+        self._execute_sm(child, from_proxy=False)
+        if child.result.status_code != ErrorCode.SUCCESS:
+            raise WukongError(child.result.status_code, "optional child failed")
+
+        cres = child.result
+        ckey = [cres.var2col(v) for v in shared]
+        new_vars = [v for v, c in sorted(cres.v2c_map.items(),
+                                         key=lambda kv: kv[1])
+                    if v not in shared and c != NR]
+        cnew = [cres.var2col(v) for v in new_vars]
+        row_idx, new_cols = _left_join(
+            res.table[:, pcols] if res.table.size
+            else np.empty((res.nrows, len(pcols)), np.int64),
+            cres.table, ckey, cnew, blank=BLANK_ID)
+        base = (res.table[row_idx] if res.table.size
+                else np.empty((len(row_idx), res.col_num), np.int64))
+        w0 = res.col_num
+        res.set_table(np.column_stack([base, new_cols])
+                      if new_cols.shape[1] else base)  # updates col_num
+        for j, v in enumerate(new_vars):
+            res.add_var2col(v, w0 + j)
+        if deferred_filters:
+            assert_ec(self.str_server is not None, ErrorCode.UNKNOWN_FILTER,
+                      "FILTER needs a string server")
+            fq = SPARQLQuery()
+            fq.pattern_group.filters = deferred_filters
+            fq.result = res
+            host._execute_filters(fq)
+
+    # ------------------------------------------------------------------
+    def _run_device_bgp(self, q: SPARQLQuery, n_steps: int, seed=None) -> None:
         cap_override: dict[int, int] = {}
+        seed_cache: dict = {}  # seed shards are retry-invariant; transfer once
         for _attempt in range(8):
-            plan = self._build_plan(q, cap_override)
-            fn, args = self._get_fn(plan)
+            plan = self._build_plan(q, cap_override, n_steps, seed)
+            fn, args = self._get_fn(plan, seed, seed_cache)
             out = fn(*args)
             import jax
 
@@ -174,38 +322,19 @@ class DistEngine:
             parts = []
             for d in range(self.D):
                 parts.append(np.asarray(tables[d][:, : int(ns[d])]).T)
-            res.set_table(np.concatenate(parts).astype(np.int64)
-                          if parts else np.empty((0, plan.width)))
-        q.pattern_step = len(q.pattern_group.patterns)
-
-    def _execute_union_branches(self, q: SPARQLQuery) -> None:
-        merged = None
-        host = self._host()
-        for sub_pg in q.pattern_group.unions:
-            assert_ec(not sub_pg.unions and not sub_pg.optional,
-                      ErrorCode.UNSUPPORTED_SHAPE,
-                      "nested groups inside UNION branches are unsupported "
-                      "in distributed v1")
-            child = SPARQLQuery()
-            child.pg_type = PGType.UNION
-            child.pattern_group = sub_pg
-            child.result.nvars = q.result.nvars
-            child.result.blind = False
-            self._execute_inner(child)
-            if sub_pg.filters:  # branch-level FILTERs run host-side per branch
-                assert_ec(self.str_server is not None, ErrorCode.UNKNOWN_FILTER,
-                          "FILTER needs a string server")
-                host._execute_filters(child)
-            merged = host._merge_union(merged, child.result, q.result.nvars)
-        q.result.v2c_map = merged.v2c_map
-        q.result.col_num = merged.col_num
-        q.result.set_table(merged.table)
-        q.union_done = True
+            tab = (np.concatenate(parts) if parts
+                   else np.empty((0, plan.width), np.int64))
+            # device tables are int32; BLANK_ID must round-trip to its
+            # uint32 host value (types.py BLANK_ID_I32)
+            res.set_table(tab.astype(np.int64) & 0xFFFFFFFF
+                          if tab.dtype == np.int32 else tab.astype(np.int64))
+        q.pattern_step += n_steps
 
     # ------------------------------------------------------------------
     # plan building (host): pattern list -> step descriptors with capacities
     # ------------------------------------------------------------------
-    def _build_plan(self, q: SPARQLQuery, cap_override: dict) -> _Plan:
+    def _build_plan(self, q: SPARQLQuery, cap_override: dict,
+                    n_steps: int | None = None, seed=None) -> _Plan:
         plan = _Plan()
         v2c: dict[int, int] = {}
         width = 0
@@ -216,13 +345,38 @@ class DistEngine:
             return cap_override.get(("cap", i)) or K.next_capacity(
                 max(int(est), self.cap_min), self.cap_min, self.cap_max)
 
-        patterns = q.pattern_group.patterns
-        for i, pat in enumerate(patterns):
+        patterns = q.pattern_group.patterns[
+            q.pattern_step:(None if n_steps is None
+                            else q.pattern_step + n_steps)]
+        if seed is not None:
+            seed_table, seed_v2c = seed
+            v2c.update(seed_v2c)
+            width = seed_table.shape[1]
+            first = patterns[0]
+            if first.subject < 0:
+                anchor = v2c.get(first.subject, NO_RESULT)
+            elif _is_index_pattern(first):  # index membership on a bound col
+                anchor = v2c.get(first.object, NO_RESULT)
+            else:
+                anchor = NO_RESULT
+            assert_ec(anchor != NO_RESULT,
+                      ErrorCode.UNSUPPORTED_SHAPE,
+                      "seeded distributed chains must start from a pattern "
+                      "anchored on a seeded column")
+            est_rows = max(len(seed_table) // self.D, 1) * 2
+            plan.steps.append(_Step(
+                kind="init_rows", col=anchor, width=width,
+                cap=self._seed_cap(seed_table, anchor)))
+            aligned_col = anchor  # seed rows are sharded by the anchor owner
+        for pat in patterns:
+            i = len(plan.steps)  # step index (seeded chains prepend init_rows)
             s, p, d, o = pat.subject, pat.predicate, pat.direction, pat.object
             assert_ec(pat.pred_type == int(AttrType.SID_t) and p >= 0,
                       ErrorCode.UNSUPPORTED_SHAPE,
-                      "attr/versatile unsupported in distributed v1")
-            if i == 0 and q.start_from_index():
+                      "attr/versatile patterns are host-side in the "
+                      "distributed engine")
+            if i == 0 and seed is None and q.pattern_step == 0 \
+                    and pat is patterns[0] and q.start_from_index():
                 idx = self.sstore.index_list(s, d)
                 est_rows = max(idx.total // self.D, 1) * 2
                 step = _Step(kind="init_index", pid=s, dir=d,
@@ -232,7 +386,25 @@ class DistEngine:
                 aligned_col = 0  # index lists are owner-local by construction
                 plan.steps.append(step)
                 continue
-            if i == 0 or width == 0:
+            if width > 0 and _is_index_pattern(pat):
+                # mid-chain index membership (index_to_known,
+                # sparql.hpp:138-163): keep rows whose bound object is in
+                # the owner shard's local index list
+                ocol = v2c.get(o, NO_RESULT)
+                assert_ec(ocol != NO_RESULT, ErrorCode.VERTEX_INVALID,
+                          "index pattern needs a bound object mid-chain")
+                exch_cap = 0
+                if aligned_col != ocol:
+                    exch_cap = cap_override.get(("exch", i)) or K.next_capacity(
+                        max(est_rows // self.D * 4, self.cap_min),
+                        self.cap_min, self.cap_max)
+                self.sstore.index_list(s, d)  # ensure staged
+                plan.steps.append(_Step(
+                    kind="member_index", pid=s, dir=d, col=ocol,
+                    cap=cap_for(i, est_rows), exch_cap=exch_cap))
+                aligned_col = ocol
+                continue
+            if width == 0:
                 assert_ec(s > 0, ErrorCode.FIRST_PATTERN_ERROR)
                 seg = self.sstore.segment(p, d)
                 est_rows = int((seg.avg_deg if seg else 1) * 2)
@@ -283,7 +455,7 @@ class DistEngine:
     # ------------------------------------------------------------------
     # compiled chain per plan signature
     # ------------------------------------------------------------------
-    def _get_fn(self, plan: _Plan):
+    def _get_fn(self, plan: _Plan, seed=None, seed_cache: dict | None = None):
         # gather the device arrays each step needs (also the call args);
         # per-step (max_probe, max_deg_log2) join the cache key because the
         # compiled chain bakes them in as constants — a restaged segment
@@ -291,7 +463,17 @@ class DistEngine:
         bounds = []
         args = []
         for s in plan.steps:
-            if s.kind == "init_index":
+            if s.kind == "init_rows":
+                key = (s.col, s.cap)
+                if seed_cache is None:
+                    args.append(self._shard_seed(seed[0], s.col, s.cap))
+                elif key not in seed_cache:
+                    seed_cache[key] = self._shard_seed(seed[0], s.col, s.cap)
+                    args.append(seed_cache[key])
+                else:
+                    args.append(seed_cache[key])
+                bounds.append((0, 0))
+            elif s.kind in ("init_index", "member_index"):
                 idx = self.sstore.index_list(s.pid, s.dir)
                 args.append((idx.edges, self._real_lens_arr(idx)))
                 bounds.append((0, 0))
@@ -316,6 +498,41 @@ class DistEngine:
 
         return jax.device_put(idx.real_lens.astype(np.int32).reshape(-1, 1),
                               NamedSharding(self.mesh, P(self.axis, None)))
+
+    def _seed_cap(self, seed_table: np.ndarray, anchor: int) -> int:
+        """Exact per-shard capacity for a seed table (host knows the counts)."""
+        from wukong_tpu.utils.mathutil import hash_mod
+
+        if len(seed_table) == 0:
+            return self.cap_min
+        dest = hash_mod(seed_table[:, anchor].astype(np.int32), self.D)
+        peak = int(np.bincount(dest, minlength=self.D).max())
+        return K.next_capacity(max(peak, 1), self.cap_min, self.cap_max)
+
+    def _shard_seed(self, seed_table: np.ndarray, anchor: int, cap: int):
+        """[N, W] host rows -> ([D, W, cap] int32 sharded, [D, 1] counts).
+
+        Rows go to hash(anchor)%D — computed on the int32 view so host
+        sharding matches the device-side `table[col] % D` exchange owner
+        (BLANK_ID wraps to -1 on both sides)."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        W = seed_table.shape[1]
+        t32 = seed_table.astype(np.int32)  # ids < 2^31; BLANK wraps to -1
+        from wukong_tpu.utils.mathutil import hash_mod
+
+        dest = hash_mod(t32[:, anchor], self.D)
+        out = np.zeros((self.D, W, cap), dtype=np.int32)
+        counts = np.zeros((self.D, 1), dtype=np.int32)
+        for d in range(self.D):
+            rows = t32[dest == d]
+            counts[d, 0] = len(rows)
+            out[d, :, : len(rows)] = rows.T
+        sharding = NamedSharding(self.mesh, P(self.axis, None, None))
+        return (jax.device_put(out, sharding),
+                jax.device_put(counts,
+                               NamedSharding(self.mesh, P(self.axis, None))))
 
     @staticmethod
     def _flatten_args(args):
@@ -343,7 +560,7 @@ class DistEngine:
         probes = {}
         depths = {}
         for i, s in enumerate(steps):
-            if s.kind != "init_index":
+            if s.kind not in ("init_index", "init_rows", "member_index"):
                 seg = self.sstore.segment(s.pid, s.dir)
                 probes[i] = seg.max_probe if seg else 1
                 depths[i] = seg.max_deg_log2 if seg else 1
@@ -364,6 +581,11 @@ class DistEngine:
             exch_totals = [jnp.int32(0)] * len(steps)
 
             for i, s in enumerate(steps):
+                if s.kind == "init_rows":
+                    table, counts = per_step[i]
+                    n = counts[0]
+                    totals[i] = n
+                    continue
                 if s.kind == "init_index":
                     edges, lens = per_step[i]
                     table, n = K.init_from_list.__wrapped__(
@@ -390,6 +612,13 @@ class DistEngine:
                         table, n, s.col, s.exch_cap, s.cap, D, axis)
                     exch_totals[i] = em
                     totals[i] = jnp.maximum(totals[i], tot_recv)
+
+                if s.kind == "member_index":
+                    edges_i, lens = per_step[i]
+                    keep = K.member_mask_list.__wrapped__(
+                        table, n, s.col, edges_i, lens[0])
+                    table, n = K.compact.__wrapped__(table, keep)
+                    continue
 
                 arrs = per_step[i]
                 if s.kind in ("expand", "expand_type_all"):
@@ -431,6 +660,64 @@ class DistEngine:
                            in_specs=tuple(arg_specs), out_specs=out_specs,
                            check_vma=False)
         return jax.jit(mapped)
+
+
+def _is_index_pattern(pat) -> bool:
+    """Type/predicate index pattern: tpid subject under rdf:type or
+    __PREDICATE__ with a variable object."""
+    from wukong_tpu.types import is_tpid
+
+    return (pat.subject > 0 and is_tpid(pat.subject)
+            and pat.predicate in (PREDICATE_ID, TYPE_ID) and pat.object < 0)
+
+
+class _ShardedAttrGraph:
+    """Attribute lookups routed to the subject owner's partition — the same
+    hash_mod placement build_partition uses for attr segments."""
+
+    def __init__(self, stores: list):
+        self.stores = stores
+        self.D = len(stores)
+
+    def get_attr(self, vid: int, aid: int, d: int = OUT):
+        from wukong_tpu.utils.mathutil import hash_mod
+
+        return self.stores[int(hash_mod(int(vid), self.D))].get_attr(
+            vid, aid, d)
+
+
+def _left_join(parent_keys: np.ndarray, child_table: np.ndarray,
+               ckey_cols: list, cnew_cols: list, blank: int):
+    """Left join on key columns: each parent key row expands by all child
+    rows with an equal key; keyless parents emit one row with `blank` in the
+    new columns. Returns (row_idx into parent, new_cols [L, len(cnew_cols)]).
+    """
+    from wukong_tpu.engine.cpu import _expand_rows
+
+    N, Kw = parent_keys.shape
+    M = len(child_table)
+    if M == 0:
+        return (np.arange(N, dtype=np.int64),
+                np.full((N, len(cnew_cols)), blank, dtype=np.int64))
+    dt = np.dtype([(f"f{i}", np.int64) for i in range(Kw)])
+    ck = np.ascontiguousarray(
+        child_table[:, ckey_cols].astype(np.int64)).view(dt).reshape(-1)
+    order = np.argsort(ck)
+    ck_s = ck[order]
+    cnew_s = (child_table[order][:, cnew_cols].astype(np.int64)
+              if cnew_cols else np.empty((M, 0), np.int64))
+    uniq, starts, cnts = np.unique(ck_s, return_index=True, return_counts=True)
+    pk = np.ascontiguousarray(parent_keys.astype(np.int64)).view(dt).reshape(-1)
+    gi = np.searchsorted(uniq, pk)
+    gi_c = np.clip(gi, 0, len(uniq) - 1)
+    matched = uniq[gi_c] == pk
+    mcount = np.where(matched, cnts[gi_c], 1)
+    row_idx, local = _expand_rows(mcount)
+    out = np.full((len(row_idx), len(cnew_cols)), blank, dtype=np.int64)
+    is_m = matched[row_idx]
+    if cnew_cols and is_m.any():
+        out[is_m] = cnew_s[starts[gi_c[row_idx[is_m]]] + local[is_m]]
+    return row_idx, out
 
 
 # ---------------------------------------------------------------------------

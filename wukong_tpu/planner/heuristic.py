@@ -42,6 +42,8 @@ def _plan_group(pg: PatternGroup) -> None:
         o_var_known = p.object < 0 and p.object in known
         if not (s_var_known or o_var_known):
             return None
+        if p.pred_type != 0:  # attr patterns last: they decorate, never prune
+            return 0
         s_bound = p.subject > 0 or s_var_known
         o_bound = p.object > 0 or o_var_known
         return 3 if (s_bound and o_bound) else 1

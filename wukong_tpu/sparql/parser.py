@@ -224,6 +224,9 @@ class Parser:
             if t[1] == "}":
                 self._next()
                 break
+            if t[1] == ".":  # stray '.' after a group, e.g. OPTIONAL { } .
+                self._next()
+                continue
             if t[1] == "{":
                 # { A } UNION { B } [UNION { C }]...
                 sub = self._parse_group()
