@@ -7,18 +7,28 @@ rewriting of the chosen start pattern (the dummy __PREDICATE__ / rdf:type
 pattern, planner.hpp:1647-1679), and a final fallback to the greedy heuristic
 when estimation fails.
 
-Simplification vs the reference (documented): the reference's "type table"
-carries the joint distribution of (var -> type) row groups; we carry per-var
-*marginal* type distributions and assume independence when combining — cheaper,
-and sufficient to reproduce the reference's plan choices on the LUBM suites.
-Cost constants play the role of planner.hpp:23-29 (AA_full/AA_early/BB_ifor/
-CC_const_known/CC_unknown), retuned for the TPU kernel profile where expansion
-rows dominate and membership filters are comparatively cheap.
+Cardinality model: the reference's **type table** — the JOINT distribution of
+variable types as rows of (count, type-per-bound-var) (planner.hpp type_table,
+stats.hpp:46-75). Each step transforms the table:
+
+- expansion: every row splits by the anchor type's fine_type neighbor
+  distribution (planner.hpp add_type_table rows);
+- a type filter keeps exactly the rows whose anchor type contains the target
+  — correlations between variables survive, which is what the earlier
+  per-var-marginal model lost (it admitted ~3x misestimates on q1/q7);
+- membership steps scale each row by an edge-density selectivity conditioned
+  on BOTH endpoint types.
+
+Rows are pruned to a bounded table (mass-preserving rescale) the way the
+reference merges rare types (stats.hpp merge_type). Cost constants play the
+role of planner.hpp:23-29 (AA_full/AA_early/BB_ifor/CC_*), retuned for the
+TPU kernel profile where expansion rows dominate and membership filters are
+comparatively cheap.
 """
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 from wukong_tpu.planner.heuristic import heuristic_plan
 from wukong_tpu.planner.stats import Stats
@@ -32,24 +42,28 @@ COST_PRODUCE = 2.0
 COST_PROBE = 0.5
 INIT_COST = 64.0  # per-step fixed dispatch cost
 
+MAX_TTAB_ROWS = 256  # joint-table row cap (reference merges rare types)
+
 
 @dataclass
 class _State:
     rows: float
-    vtypes: dict  # var -> {type: weight} marginal distribution
+    vars: tuple  # bound vars, in type-table column order
+    ttab: dict  # {(t_1, ..., t_k): count} joint type distribution
     cost: float
     plan: list
 
 
-def _rescale(vtypes: dict, factor: float, skip: int | None = None) -> dict:
-    """Scale every var's marginal mass by `factor` (row-count change)."""
-    out = {}
-    for v, dist in vtypes.items():
-        if v == skip:
-            out[v] = dict(dist)
-        else:
-            out[v] = {t: c * factor for t, c in dist.items()}
-    return out
+def _prune(ttab: dict) -> dict:
+    """Bound the joint table, preserving total mass (merge_type analogue)."""
+    if len(ttab) <= MAX_TTAB_ROWS:
+        return ttab
+    items = sorted(ttab.items(), key=lambda kv: -kv[1])
+    kept = dict(items[:MAX_TTAB_ROWS])
+    total = sum(ttab.values())
+    kept_total = sum(kept.values()) or 1.0
+    scale = total / kept_total
+    return {k: v * scale for k, v in kept.items()}
 
 
 class Planner:
@@ -119,20 +133,19 @@ class Planner:
                 if p.subject >= NORMAL_ID_START:
                     out.append(self._mk_start(
                         Pattern(p.subject, p.predicate, OUT, p.object), p,
-                        rows=8.0, var=p.object, dist={0: 8.0}))
+                        var=p.object, dist={0: 8.0}))
                 elif p.object >= NORMAL_ID_START:
                     out.append(self._mk_start(
                         Pattern(p.object, p.predicate, IN, p.subject), p,
-                        rows=8.0, var=p.subject, dist={0: 8.0}))
+                        var=p.subject, dist={0: 8.0}))
                 continue
             if p.predicate == TYPE_ID and p.subject < 0 and is_tpid(p.object):
                 # type-index start: ?X rdf:type T  ->  (T, rdf:type, IN, ?X)
-                cnt = float(st.count_containing(p.object))
                 dist = {t: float(st.tyscount.get(t, 0))
                         for t in st.types_containing(p.object)}
                 out.append(self._mk_start(
                     Pattern(p.object, TYPE_ID, IN, p.subject), p,
-                    rows=cnt, var=p.subject, dist=dist))
+                    var=p.subject, dist=dist))
                 continue
             if p.subject >= NORMAL_ID_START and p.object < 0:
                 deg = self._const_fanout(p.predicate, OUT)
@@ -144,7 +157,7 @@ class Planner:
                 out.append(self._mk_start(
                     Pattern(p.subject, p.predicate, OUT, p.object,
                             p.pred_type), p,
-                    rows=deg, var=p.object, dist=self._norm(dist, deg)))
+                    var=p.object, dist=self._norm(dist, deg)))
             if p.object >= NORMAL_ID_START and p.subject < 0:
                 deg = self._const_fanout(p.predicate, IN)
                 ct = st.type_of(p.object)
@@ -153,20 +166,21 @@ class Planner:
                 out.append(self._mk_start(
                     Pattern(p.object, p.predicate, IN, p.subject,
                             p.pred_type), p,
-                    rows=deg, var=p.subject, dist=self._norm(dist, deg)))
+                    var=p.subject, dist=self._norm(dist, deg)))
             if p.subject < 0 and p.object < 0 and p.predicate > 1:
                 # predicate-index start (both sides): dummy __PREDICATE__
-                nsub = float(sum(st.pstype.get(p.predicate, {}).values()))
                 dist = {t: float(c) for t, c in
                         st.pstype.get(p.predicate, {}).items()}
                 out.append(self._mk_start(
                     Pattern(p.predicate, PREDICATE_ID, IN, p.subject), None,
-                    rows=nsub, var=p.subject, dist=dist))
+                    var=p.subject, dist=dist))
         return out
 
-    def _mk_start(self, pat: Pattern, consumes, rows: float, var: int, dist):
-        return _State(rows=max(rows, 1.0),
-                      vtypes={var: dist or {0: max(rows, 1.0)}},
+    def _mk_start(self, pat: Pattern, consumes, var: int, dist):
+        dist = {t: c for t, c in (dist or {}).items() if c > 0} or {0: 1.0}
+        rows = sum(dist.values())
+        return _State(rows=max(rows, 1.0), vars=(var,),
+                      ttab={(t,): c for t, c in dist.items()},
                       cost=INIT_COST + rows * COST_PRODUCE,
                       plan=[(pat, consumes)])
 
@@ -185,87 +199,129 @@ class Planner:
         return {t: c / tot * rows for t, c in dist.items()}
 
     # ------------------------------------------------------------------
-    # step estimation (fine_type-driven, planner.hpp cost model analogue)
+    # step estimation over the joint type table (planner.hpp:218-874)
     # ------------------------------------------------------------------
     def _estimate_step(self, state: _State, p: Pattern) -> _State | None:
         st = self.stats
-        s_b = p.subject in state.vtypes or p.subject > 0
-        o_b = p.object in state.vtypes or p.object > 0
+        s_var_b = p.subject < 0 and p.subject in state.vars
+        o_var_b = p.object < 0 and p.object in state.vars
         if p.predicate < 0:
-            if not (s_b or o_b):
+            if not (s_var_b or o_var_b or p.subject > 0 or p.object > 0):
                 return None
-            # versatile expansion: pessimistic constant fanout
+            # versatile expansion: pessimistic constant fanout, untyped var
             rows = state.rows * 8.0
-            vt = dict(state.vtypes)
-            for v in (p.subject, p.predicate, p.object):
-                if v < 0 and v not in vt:
-                    vt[v] = {0: rows}
-            return _State(rows, vt, state.cost + INIT_COST
-                          + state.rows * COST_SCAN + rows * COST_PRODUCE,
+            nvars = tuple(v for v in (p.subject, p.predicate, p.object)
+                          if v < 0 and v not in state.vars)
+            ttab = {types + (0,) * len(nvars): c * 8.0
+                    for types, c in state.ttab.items()}
+            return _State(rows, state.vars + nvars, ttab,
+                          state.cost + INIT_COST + state.rows * COST_SCAN
+                          + rows * COST_PRODUCE,
                           state.plan + [(self._orient(state, p), p)])
-        s_var_b = p.subject < 0 and p.subject in state.vtypes
-        o_var_b = p.object < 0 and p.object in state.vtypes
         if not (s_var_b or o_var_b):
             return None
         oriented = self._orient(state, p)
-        anchor_var = oriented.subject
-        anchor_dist = state.vtypes.get(anchor_var, {})
         d = oriented.direction
-        # invariant: every bound var's marginal mass tracks the current row
-        # count (sum(vtypes[v]) ~= rows); after any step that changes rows,
-        # every other var's marginal is rescaled proportionally — without this
-        # an already-expanded var keeps its original cardinality and later
-        # expansions on it are wildly underestimated.
-        if oriented.predicate == TYPE_ID and oriented.object > 0:
-            # type filter: keep rows whose anchor type contains the target
-            keep_types = set(st.types_containing(oriented.object))
-            kept = sum(c for t, c in anchor_dist.items() if t in keep_types)
-            total = sum(anchor_dist.values()) or 1.0
-            sel = kept / total
-            rows = max(state.rows * sel, 0.01)
-            vt = _rescale(state.vtypes, sel, skip=anchor_var)
-            vt[anchor_var] = {t: c for t, c in anchor_dist.items()
-                              if t in keep_types} or {0: rows}
-            return _State(rows, vt, state.cost + INIT_COST
-                          + state.rows * COST_PROBE, state.plan + [(oriented, p)])
-        if oriented.object < 0 and oriented.object not in state.vtypes:
-            # expansion: fanout from fine_type over the anchor's marginal
+        if oriented.subject > 0:
+            # const anchor mid-plan: only membership on a bound object is
+            # executable (const_to_known); the const's own type conditions
+            # the per-row selectivity
+            if not (oriented.object < 0 and oriented.object in state.vars):
+                return None
+            const_t = st.type_of(oriented.subject)
+            ia = None
+        else:
+            const_t = 0
+            ia = state.vars.index(oriented.subject)
+
+        def anchor_type(types):
+            return const_t if ia is None else types[ia]
+
+        if oriented.predicate == TYPE_ID and oriented.object > 0 \
+                and ia is not None:
+            # type filter: KEEP exactly the joint rows whose anchor type
+            # contains the target — the joint table's whole point: no
+            # independence assumption, correlations survive
+            keep = set(st.types_containing(oriented.object))
+            ttab = {types: c for types, c in state.ttab.items()
+                    if types[ia] in keep}
+            rows = max(sum(ttab.values()), 0.01)
+            return _State(rows, state.vars, ttab or {(0,) * len(state.vars): rows},
+                          state.cost + INIT_COST + state.rows * COST_PROBE,
+                          state.plan + [(oriented, p)])
+
+        if oriented.object < 0 and oriented.object not in state.vars:
+            # expansion: each joint row splits by the anchor type's fine_type
+            # neighbor distribution
+            ttab: dict[tuple, float] = {}
             rows_out = 0.0
-            ndist: dict[int, float] = {}
-            for t, c in anchor_dist.items():
+            for types, c in state.ttab.items():
+                t = types[ia]
                 ft = st.fine_type.get((t, oriented.predicate, d), {})
                 t_pop = float(st.tyscount.get(t, 1)) or 1.0
-                fanout = sum(ft.values()) / t_pop
-                rows_out += c * fanout
+                if not ft:
+                    # untyped anchor (e.g. versatile var): global pred fanout
+                    fan = self._const_fanout(oriented.predicate, d) \
+                        if t == 0 else 0.0
+                    if fan > 0:
+                        key = types + (0,)
+                        ttab[key] = ttab.get(key, 0.0) + c * fan
+                        rows_out += c * fan
+                    continue
                 for nt, ec in ft.items():
-                    share = c * fanout * (ec / (sum(ft.values()) or 1.0))
-                    ndist[nt] = ndist.get(nt, 0.0) + share
+                    share = c * (ec / t_pop)
+                    key = types + (nt,)
+                    ttab[key] = ttab.get(key, 0.0) + share
+                    rows_out += share
             rows_out = max(rows_out, 0.0)
-            factor = rows_out / max(state.rows, 1e-9)
-            vt = _rescale(state.vtypes, factor)
-            vt[oriented.object] = ndist or {0: rows_out}
-            return _State(rows_out, vt, state.cost + INIT_COST
-                          + state.rows * COST_SCAN + rows_out * COST_PRODUCE,
+            return _State(rows_out, state.vars + (oriented.object,),
+                          _prune(ttab) or {(0,) * (len(state.vars) + 1): 0.01},
+                          state.cost + INIT_COST + state.rows * COST_SCAN
+                          + rows_out * COST_PRODUCE,
                           state.plan + [(oriented, p)])
-        # membership filter (k2k / k2c): selectivity from edge density over
-        # DISTINCT endpoint populations (pstype/potype are per-edge histograms;
-        # their sums equal pred_edges and must not be used as populations)
+
+        # membership (k2k / k2c): per-row selectivity conditioned on the
+        # anchor row's type (and the other endpoint's type for k2k)
         pe = float(st.pred_edges.get(oriented.predicate, 1))
-        subj_pop = float(st.distinct_subj.get(oriented.predicate, 1)) or 1.0
-        obj_pop = float(st.distinct_obj.get(oriented.predicate, 1)) or 1.0
-        if oriented.object > 0:
-            # known anchor vs one specific const: P(edge to THE const)
-            sel = (pe / obj_pop) / subj_pop
-        else:
-            # two known vars: P(edge between a random pair)
-            sel = pe / (subj_pop * obj_pop)
-        sel = min(sel, 1.0)
-        rows = max(state.rows * sel, 0.01)
-        return _State(rows, _rescale(state.vtypes, sel), state.cost + INIT_COST
-                      + state.rows * COST_PROBE, state.plan + [(oriented, p)])
+        sp = float(st.distinct_subj.get(oriented.predicate, 1)) or 1.0
+        op = float(st.distinct_obj.get(oriented.predicate, 1)) or 1.0
+        ttab: dict[tuple, float] = {}
+        rows = 0.0
+        for types, c in state.ttab.items():
+            t = anchor_type(types)
+            ft = st.fine_type.get((t, oriented.predicate, d), {})
+            t_pop = float(st.tyscount.get(t, 1)) or 1.0
+            if oriented.object > 0:  # k2c: edge to THE specific const
+                if not ft:  # untyped anchor: global density per const
+                    sel = (pe / op) / sp
+                else:
+                    ct = st.type_of(oriented.object)
+                    targets = {ct} if ct else set(ft)
+                    ec = sum(v for nt, v in ft.items() if nt in targets)
+                    pop = float(sum(st.tyscount.get(nt, 1)
+                                    for nt in targets)) or 1.0
+                    sel = (ec / t_pop) / pop
+            else:  # k2k: edge to the row's specific o-instance
+                if not ft:  # untyped: global density
+                    sel = pe / (sp * op)
+                else:
+                    io = state.vars.index(oriented.object)
+                    to = types[io]
+                    ec = float(ft.get(to, 0))
+                    pop = float(st.tyscount.get(to, 1)) or 1.0
+                    sel = (ec / t_pop) / pop
+            sel = min(sel, 1.0)
+            if c * sel > 0:
+                ttab[types] = ttab.get(types, 0.0) + c * sel
+                rows += c * sel
+        rows = max(rows, 0.01)
+        return _State(rows, state.vars,
+                      ttab or {(0,) * len(state.vars): rows},
+                      state.cost + INIT_COST + state.rows * COST_PROBE,
+                      state.plan + [(oriented, p)])
 
     def _orient(self, state: _State, p: Pattern) -> Pattern:
-        s_var_b = p.subject < 0 and p.subject in state.vtypes
+        s_var_b = p.subject < 0 and p.subject in state.vars
         pred_var = p.predicate < 0
         if s_var_b or (p.subject > 0 and not pred_var):
             return Pattern(p.subject, p.predicate, OUT, p.object, p.pred_type)
