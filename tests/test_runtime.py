@@ -214,3 +214,39 @@ def test_mt_factor_never_truncates_results(proxy):
     sliced = proxy.run_single_query(open(f"{BASIC}/lubm_q2").read(),
                                     device="cpu", blind=True, mt_factor=8)
     assert sliced.result.nrows == full.result.nrows
+
+
+def test_emulator_open_loop_pool(proxy, monkeypatch):
+    """Host path keeps -p queries in flight across the engine pool; every
+    submitted query completes and is recorded."""
+    monkeypatch.setattr(Global, "enable_tpu", False)
+    mix = load_mix_config(f"{EMU}/mix_config", proxy.str_server)
+    emu = Emulator(proxy)
+    out = emu.run(mix, duration_s=0.5, warmup_s=0.1, parallel=4)
+    assert out["thpt_qps"] > 0
+    # all latency records drained (no stranded in-flight queries)
+    assert proxy.engine_pool().poll() == []
+
+
+def test_emulator_heavy_batched_device(proxy, monkeypatch):
+    """Heavy index-origin emulator classes go through execute_batch_index."""
+    monkeypatch.setattr(Global, "enable_tpu", True)
+    calls = []
+    orig = proxy.tpu.execute_batch_index
+
+    def spy(q, B, slice_mode=False):
+        calls.append(B)
+        return orig(q, B, slice_mode)
+
+    monkeypatch.setattr(proxy.tpu, "execute_batch_index", spy)
+    import os
+    import tempfile
+
+    basic = "/root/reference/scripts/sparql_query/lubm/basic"
+    d = tempfile.mkdtemp()
+    with open(os.path.join(d, "mix"), "w") as f:
+        f.write(f"0 1\n{basic}/lubm_q2 1\n")
+    mix = load_mix_config(os.path.join(d, "mix"), proxy.str_server)
+    out = Emulator(proxy).run(mix, duration_s=0.5, warmup_s=0.1)
+    assert out["thpt_qps"] > 0
+    assert calls and all(b >= 1 for b in calls)

@@ -185,3 +185,28 @@ def test_suggest_index_batch(engines, world):
     heuristic_plan(q)
     b = tpu.suggest_index_batch(q)
     assert 1 <= b <= 1024
+
+
+def test_prefetch_pipelining_stages_chain_segments(engines, world, monkeypatch):
+    """gpu_enable_pipeline stages every chain segment before dispatch."""
+    from wukong_tpu.config import Global
+    from wukong_tpu.engine.tpu import TPUEngine
+
+    g, ss = world
+    monkeypatch.setattr(Global, "gpu_enable_pipeline", True)
+    tpu = TPUEngine(g, ss)
+    staged = []
+    orig = tpu.dstore.prefetch
+    monkeypatch.setattr(tpu.dstore, "prefetch",
+                        lambda pats: (staged.append(1), orig(pats))[1])
+    q = Parser(ss).parse(open(f"{BASIC}/lubm_q4").read())
+    heuristic_plan(q)
+    tpu.execute(q)
+    assert q.result.status_code == 0 and staged
+
+    monkeypatch.setattr(Global, "gpu_enable_pipeline", False)
+    staged.clear()
+    q = Parser(ss).parse(open(f"{BASIC}/lubm_q4").read())
+    heuristic_plan(q)
+    tpu.execute(q)
+    assert q.result.status_code == 0 and not staged

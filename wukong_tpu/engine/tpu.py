@@ -94,6 +94,21 @@ class TPUEngine:
                     for i in range(q.pattern_step, q.pattern_step + device_steps)
                     if q.get_pattern(i).predicate > 0]
             self.dstore.pin(pins)
+            if Global.gpu_enable_pipeline:
+                # stage every chain segment up front: device_put dispatches
+                # asynchronously, so the H2D transfers overlap the first
+                # steps' compute (gpu_engine_cuda.hpp:143-150's second-stream
+                # prefetch, collapsed into the async dispatch queue). An
+                # index-origin START consumes an index list, not a segment —
+                # staging its (TYPE_ID, dir) segment would build the whole
+                # type CSR for nothing, so it is skipped.
+                lo = q.pattern_step
+                if lo == 0 and q.start_from_index() \
+                        and _is_index_start(q.get_pattern(0)):
+                    lo = 1
+                self.dstore.prefetch(
+                    q.get_pattern(i) for i in
+                    range(lo, q.pattern_step + device_steps))
             try:
                 self._run_chain_pinned(q, device_steps)
             finally:
@@ -327,6 +342,10 @@ class TPUEngine:
         pats = q.pattern_group.patterns
         pins = [(p.predicate, p.direction) for p in pats if p.predicate > 0]
         self.dstore.pin(pins)
+        if Global.gpu_enable_pipeline:
+            # skip an index-origin start — it consumes an index list
+            skip0 = q.start_from_index() and _is_index_start(pats[0])
+            self.dstore.prefetch(pats[1:] if skip0 else pats)
         try:
             cap_override: dict[int, int] = {}
             for _attempt in range(8):

@@ -24,7 +24,7 @@ logger <level>               set log level (0..7)
 sparql -f <file> [-m <f>] [-n <n>] [-p <plan>] [-N] [-v <n>] [-d cpu|tpu|dist]
                              run a single SPARQL query
 sparql -b <file>             run a batch of `sparql` commands from a file
-sparql-emu -f <mix_config> [-d <sec>] [-w <sec>] [-b <batch>]
+sparql-emu -f <mix_config> [-d <sec>] [-w <sec>] [-b <batch>] [-p <inflight>]
                              run the open-loop throughput emulator
 load -d <dir>                dynamic (incremental) load
 gsck [-i] [-n]               check store integrity
@@ -147,9 +147,12 @@ class Console:
         ap.add_argument("-d", type=float, default=5.0)
         ap.add_argument("-w", type=float, default=1.0)
         ap.add_argument("-b", type=int, default=None)
+        ap.add_argument("-p", type=int, default=None,
+                        help="in-flight cap across the engine pool")
         ns = ap.parse_args(rest)
         mix = load_mix_config(ns.f, self.proxy.str_server)
-        Emulator(self.proxy).run(mix, duration_s=ns.d, warmup_s=ns.w, batch=ns.b)
+        Emulator(self.proxy).run(mix, duration_s=ns.d, warmup_s=ns.w,
+                                 batch=ns.b, parallel=ns.p)
 
     def _stat(self, rest, load: bool) -> None:
         """load-stat / store-stat: persist optimizer statistics

@@ -15,6 +15,7 @@ Two execution paths:
 from __future__ import annotations
 
 import os
+import time
 
 import numpy as np
 
@@ -68,8 +69,15 @@ class Emulator:
 
     # ------------------------------------------------------------------
     def run(self, mix: MixConfig, duration_s: float = 5.0, warmup_s: float = 1.0,
-            batch: int | None = None, seed: int = 0) -> dict:
-        """Open loop for `duration_s`; returns {thpt, cdf per class}."""
+            batch: int | None = None, seed: int = 0,
+            parallel: int | None = None) -> dict:
+        """Open loop for `duration_s` keeping up to `parallel` queries in
+        flight across the host engine pool (the reference's `-p` cap,
+        proxy.hpp:477-525); returns {thpt, cdf per class}.
+
+        Device-batchable classes run as synchronous compiled batches (the
+        batch dimension IS the pipeline there): light templates through
+        execute_batch, index-origin heavies through execute_batch_index."""
         for tmpl in mix.templates:
             self.proxy.fill_template(tmpl)
         rng = np.random.default_rng(seed)
@@ -77,6 +85,8 @@ class Emulator:
         nclasses = len(mix.templates) + len(mix.heavies)
         use_tpu = (self.proxy.tpu is not None and Global.enable_tpu)
         B = batch or Global.device_batch
+        p_cap = max(parallel or Global.num_engines, 1)
+        pool = self.proxy.engine_pool()
 
         # pre-plan one query per class (remembering the instantiated
         # placeholder value so _batchable can confirm the plan starts from it)
@@ -91,30 +101,27 @@ class Emulator:
         for text in mix.heavies:
             q = Parser(self.proxy.str_server).parse(text)
             heuristic_plan(q)
+            q._heavy_b = 0  # lazily-computed device batch size
             planned.append(("heavy", None, q))
 
         self.monitor.start_thpt()
         t_end = get_usec() + int((duration_s + warmup_s) * 1e6)
         t_measure = get_usec() + int(warmup_s * 1e6)
         warm = True
-        while get_usec() < t_end:
+        inflight: dict[int, tuple] = {}
+        errors = 0
+        first_error: Exception | None = None
+        while get_usec() < t_end or inflight:
             if warm and get_usec() >= t_measure:
                 self.monitor.start_thpt()
                 warm = False
-            cls = int(rng.choice(nclasses, p=probs))
-            kind, tmpl, q0 = planned[cls]
-            if kind == "light" and use_tpu and self._batchable(tmpl, q0):
-                consts = self._draw_consts(tmpl, rng, B)
-                t0 = get_usec()
-                try:
-                    self.proxy.tpu.execute_batch(q0, consts)
-                except WukongError:
-                    # fall back to per-instance execution for this class
-                    q0._inst_const = None  # disables _batchable next rounds
-                    continue
-                dt = get_usec() - t0
-                self.monitor.add_latency(dt / B, qtype=cls, count=B)
-            else:
+            submitted = False
+            while len(inflight) < p_cap and get_usec() < t_end:
+                cls = int(rng.choice(nclasses, p=probs))
+                kind, tmpl, q0 = planned[cls]
+                if use_tpu and self._device_batch(kind, tmpl, q0, rng, B, cls):
+                    submitted = True
+                    break  # a sync batch ran — let the outer loop poll/print
                 import copy
 
                 if tmpl is not None:
@@ -123,18 +130,63 @@ class Emulator:
                 else:
                     q = copy.deepcopy(q0)  # heavy classes reuse the cached plan
                 q.result.blind = True
-                eng = self.proxy.tpu if use_tpu else self.proxy.cpu
-                t0 = get_usec()
-                (eng or self.proxy.cpu).execute(q)
+                inflight[pool.submit(q)] = (cls, get_usec())
+                submitted = True
+            done = pool.poll()
+            for qid, out in done:
+                cls, t0 = inflight.pop(qid)
+                if isinstance(out, Exception):
+                    # engine crashes must not count as served queries
+                    errors += 1
+                    first_error = first_error or out
+                    continue
                 self.monitor.add_latency(get_usec() - t0, qtype=cls)
+            if not submitted and not done:
+                time.sleep(0.0002)  # open loop idle tick
             self.monitor.maybe_print_thpt()
 
         thpt = self.monitor.thpt()
+        if errors:
+            from wukong_tpu.utils.logger import log_warn
+
+            log_warn(f"sparql-emu: {errors} queries crashed "
+                     f"(first: {first_error!r})")
+            if thpt == 0:
+                raise RuntimeError(
+                    f"sparql-emu: every query failed: {first_error!r}")
         log_info(f"sparql-emu: {thpt:,.0f} q/s over {duration_s}s "
-                 f"({'TPU batch' if use_tpu else 'CPU'} path)")
+                 f"({'TPU batch + ' if use_tpu else ''}pool p={p_cap})")
         self.monitor.print_cdf()
-        return {"thpt_qps": thpt,
+        return {"thpt_qps": thpt, "errors": errors,
                 "cdf": {c: self.monitor.cdf(c) for c in range(nclasses)}}
+
+    def _device_batch(self, kind, tmpl, q0, rng, B: int, cls: int) -> bool:
+        """Try the synchronous compiled-batch path; True when it ran."""
+        if kind == "light" and self._batchable(tmpl, q0):
+            consts = self._draw_consts(tmpl, rng, B)
+            t0 = get_usec()
+            try:
+                self.proxy.tpu.execute_batch(q0, consts)
+            except WukongError:
+                q0._inst_const = None  # disables _batchable next rounds
+                return False
+            self.monitor.add_latency((get_usec() - t0) / B, qtype=cls, count=B)
+            return True
+        if kind == "heavy" and q0.start_from_index() \
+                and getattr(q0, "_heavy_b", -1) >= 0:
+            if q0._heavy_b == 0:
+                q0._heavy_b = min(self.proxy.tpu.suggest_index_batch(q0), 64)
+            bh = q0._heavy_b
+            t0 = get_usec()
+            try:
+                self.proxy.tpu.execute_batch_index(q0, bh)
+            except WukongError:
+                q0._heavy_b = -1  # fall back to the pool for this class
+                return False
+            self.monitor.add_latency((get_usec() - t0) / bh, qtype=cls,
+                                     count=bh)
+            return True
+        return False
 
     # ------------------------------------------------------------------
     @staticmethod

@@ -34,6 +34,20 @@ class Proxy:
         self.dist = dist_engine
         self.planner = planner  # cost-based optimizer (optional)
         self.monitor = Monitor()
+        self._pool = None
+
+    def engine_pool(self):
+        """Lazily-started host engine pool (N CPU engines with stealing and
+        adaptive snooze — wukong.cpp:202-225 spawns these at boot; here the
+        first concurrent workload starts them)."""
+        if self._pool is None:
+            from wukong_tpu.engine.cpu import CPUEngine
+            from wukong_tpu.runtime.scheduler import EnginePool
+
+            self._pool = EnginePool(
+                make_engine=lambda tid: CPUEngine(self.g, self.str_server))
+            self._pool.start()
+        return self._pool
 
     # ------------------------------------------------------------------
     def _plan(self, q: SPARQLQuery, plan_text: str | None = None) -> None:

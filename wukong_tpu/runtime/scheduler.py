@@ -35,6 +35,7 @@ class EnginePool:
         self._results_lock = threading.Lock()
         self._next_qid = 0
         self._done = {}
+        self._completed = collections.deque()  # finished qids (poll() feed)
 
     # ------------------------------------------------------------------
     def start(self) -> None:
@@ -73,7 +74,28 @@ class EnginePool:
             raise TimeoutError(f"query {qid} still running")
         with self._results_lock:
             self._done.pop(qid, None)
+            try:
+                self._completed.remove(qid)
+            except ValueError:
+                pass
             return self._results.pop(qid, None)
+
+    def poll(self) -> list:
+        """Drain finished queries as (qid, result) pairs — the open-loop
+        receive side (proxy.hpp tryrecv_reply analogue). A pool user should
+        consume completions via EITHER wait() or poll(), not both."""
+        out = []
+        while True:
+            try:
+                qid = self._completed.popleft()
+            except IndexError:
+                break
+            with self._results_lock:
+                if qid not in self._done:  # already consumed via wait()
+                    continue
+                self._done.pop(qid)
+                out.append((qid, self._results.pop(qid, None)))
+        return out
 
     # ------------------------------------------------------------------
     def _neighbors(self, tid: int) -> list[int]:
@@ -115,3 +137,4 @@ class EnginePool:
             with self._results_lock:
                 self._results[qid] = out
             self._done[qid].set()
+            self._completed.append(qid)
