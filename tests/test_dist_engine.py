@@ -307,3 +307,63 @@ def test_dist_optional_filter_on_parent_var(world):
         OPTIONAL {{ ?S ub:doctoralDegreeFrom ?DOC . FILTER(?UG != ?DOC) }} .
     }}"""
     _compare(world, text)
+
+
+def test_dist_skew_aware_exchange_no_retry(eight_cpu_devices):
+    """Hub-skewed exchanges: the multiplicity-bound capacity estimate must
+    absorb a University0-style hot destination on the FIRST attempt (the
+    reference absorbs skew via work stealing, engine.hpp:186-207)."""
+    from wukong_tpu.loader.generic_rdf import generate_generic
+    from wukong_tpu.sparql.ir import Pattern, SPARQLQuery
+
+    triples, meta = generate_generic(20_000, n_preds=8, n_types=4, seed=5)
+    g1 = build_partition(triples, 0, 1)
+    stores = build_all_partitions(triples, 8)
+    dist = DistEngine(stores, None, make_mesh(8))
+    # two-hop through the hub-attracting object column: the exchange keys on
+    # a column whose values concentrate into hubs
+    from wukong_tpu.types import TYPE_ID
+
+    pids = np.unique(triples[:, 1])
+    pids = [int(p) for p in pids if p != TYPE_ID][:2]
+
+    def mk():
+        q = SPARQLQuery()
+        q.pattern_group.patterns = [
+            Pattern(pids[0], 0, 0, -1),  # __PREDICATE__ index start
+            Pattern(-1, pids[0], 1, -2),  # expand: objects (hub-skewed)
+            Pattern(-2, pids[1], 1, -3),  # exchange on the hub column
+        ]
+        q.result.nvars = 3
+        q.result.required_vars = [-1, -2, -3]
+        return q
+
+    builds = []
+    orig = dist._build_plan
+
+    def spy(q, cap_override, n_steps=None, seed=None):
+        builds.append(1)
+        return orig(q, cap_override, n_steps, seed)
+
+    dist._build_plan = spy
+    qd = mk()
+    dist.execute(qd, from_proxy=False)
+    assert qd.result.status_code == 0
+    assert len(builds) == 1, f"capacity retries happened: {len(builds) - 1}"
+
+    # the multiplicity bound must cover the true hot-destination load even
+    # where the naive est//D*4 slack would not (it matters at pod-scale D,
+    # where 4/D of the inflated estimate undershoots a dominant hub)
+    plan = orig(mk(), {}, n_steps=3)
+    exch_step = plan.steps[2]
+    assert exch_step.exch_cap > 0
+    hub_edges = triples[triples[:, 1] == pids[0]][:, 2]
+    hot_mult = int(np.bincount(hub_edges - hub_edges.min()).max())
+    assert exch_step.exch_cap >= hot_mult
+
+    cpu = CPUEngine(g1, None)
+    qc = mk()
+    cpu.execute(qc, from_proxy=False)
+    got = sorted(map(tuple, qd.result.table.tolist()))
+    want = sorted(map(tuple, qc.result.table.tolist()))
+    assert got == want

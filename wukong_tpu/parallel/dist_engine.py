@@ -340,10 +340,33 @@ class DistEngine:
         width = 0
         aligned_col = None  # column rows are currently partitioned by
         est_rows = 1
+        # upper bound on how many rows can share one value per column —
+        # exchanges route equal values to one destination, so the hot-dest
+        # load is bounded by est/D + the anchor column's multiplicity (the
+        # University0-hub skew the reference absorbs via work stealing,
+        # engine.hpp:186-207). Index/const starts yield unique values
+        # (bound 1); expansions multiply every column's bound by the
+        # segment's max degree, and the new column's bound is the REVERSE
+        # segment's max degree times the anchor's. Unknown columns (seeds)
+        # stay untracked -> the generic 4x-slack estimate.
+        col_mult: dict[int, int] = {}
+        MULT_CAP = 1 << 31
 
         def cap_for(i, est):
             return cap_override.get(("cap", i)) or K.next_capacity(
                 max(int(est), self.cap_min), self.cap_min, self.cap_max)
+
+        def exch_cap_for(i, col):
+            got = cap_override.get(("exch", i))
+            if got:
+                return got
+            base = max(est_rows // self.D * 4, self.cap_min)
+            hot = col_mult.get(col)
+            if hot is not None:
+                base = max(base, min(int(hot), int(est_rows))
+                           + est_rows // self.D * 2)
+            return K.next_capacity(min(base, self.cap_max),
+                                   self.cap_min, self.cap_max)
 
         patterns = q.pattern_group.patterns[
             q.pattern_step:(None if n_steps is None
@@ -383,6 +406,7 @@ class DistEngine:
                              cap=cap_for(i, est_rows))
                 v2c[o] = 0
                 width = 1
+                col_mult[0] = 1  # index members are globally unique
                 aligned_col = 0  # index lists are owner-local by construction
                 plan.steps.append(step)
                 continue
@@ -395,9 +419,7 @@ class DistEngine:
                           "index pattern needs a bound object mid-chain")
                 exch_cap = 0
                 if aligned_col != ocol:
-                    exch_cap = cap_override.get(("exch", i)) or K.next_capacity(
-                        max(est_rows // self.D * 4, self.cap_min),
-                        self.cap_min, self.cap_max)
+                    exch_cap = exch_cap_for(i, ocol)
                 self.sstore.index_list(s, d)  # ensure staged
                 plan.steps.append(_Step(
                     kind="member_index", pid=s, dir=d, col=ocol,
@@ -412,6 +434,7 @@ class DistEngine:
                              cap=cap_for(i, est_rows))
                 v2c[o] = 0
                 width = 1
+                col_mult[0] = 1  # one const's neighbor list: unique values
                 aligned_col = None  # rows sit on the const's owner, not col 0's
                 plan.steps.append(step)
                 continue
@@ -425,9 +448,7 @@ class DistEngine:
             type_all = (p == TYPE_ID and d == IN and o < 0 and not o_known)
             exch_cap = 0
             if not type_all and aligned_col != col:
-                exch_cap = cap_override.get(("exch", i)) or K.next_capacity(
-                    max(est_rows // self.D * 4, self.cap_min),
-                    self.cap_min, self.cap_max)
+                exch_cap = exch_cap_for(i, col)
 
             seg = self.sstore.segment(p, d)
             avg = seg.avg_deg if seg else 0.0
@@ -437,6 +458,18 @@ class DistEngine:
                 step = _Step(kind=kind, pid=p, dir=d, col=col,
                              cap=min(cap_for(i, est_rows), self.cap_max),
                              exch_cap=exch_cap, new_col=True)
+                if type_all:
+                    col_mult.clear()  # allgather replication: bounds unknown
+                else:
+                    fwd_max = seg.max_deg if seg else 1
+                    # host metadata only — staging the reverse segment to
+                    # device for one scalar would waste HBM
+                    rev_max = self.sstore.host_max_deg(p, OUT if d == IN else IN)
+                    anchor_mult = col_mult.get(col)
+                    for c in list(col_mult):
+                        col_mult[c] = min(col_mult[c] * fwd_max, MULT_CAP)
+                    if anchor_mult is not None:
+                        col_mult[width] = min(anchor_mult * rev_max, MULT_CAP)
                 v2c[o] = width
                 width += 1
                 aligned_col = width - 1 if type_all else col

@@ -29,6 +29,7 @@ class StackedSegment:
     max_probe: int
     max_deg_log2: int
     avg_deg: float  # global average degree (capacity estimation)
+    max_deg: int = 1  # global max degree (skew-aware exchange capacities)
 
     @property
     def nbytes(self) -> int:
@@ -131,6 +132,7 @@ class ShardedDeviceStore:
             max_probe=max_probe,
             max_deg_log2=max(int(max_deg).bit_length(), 1),
             avg_deg=tot_e / max(tot_k, 1),
+            max_deg=int(max_deg),
         )
         self._cache[key] = seg
         self.bytes_used += seg.nbytes
@@ -140,6 +142,16 @@ class ShardedDeviceStore:
         from wukong_tpu.engine.device_store import type_index_csr
 
         return type_index_csr(g)
+
+    def host_max_deg(self, pid: int, d: int) -> int:
+        """Global max degree of (pid, d) from host CSR metadata — no device
+        staging (capacity estimation reads only this scalar)."""
+        md = 0
+        for g in self.stores:
+            host = g.segments.get((int(pid), int(d)))
+            if host is not None and len(host.offsets) > 1:
+                md = max(md, int(np.diff(host.offsets).max()))
+        return max(md, 1)
 
     # ------------------------------------------------------------------
     def index_list(self, tpid: int, d: int) -> StackedIndex:

@@ -134,7 +134,10 @@ class Emulator:
                 submitted = True
             done = pool.poll()
             for qid, out in done:
-                cls, t0 = inflight.pop(qid)
+                info = inflight.pop(qid, None)
+                if info is None:  # stale completion from an aborted prior run
+                    continue
+                cls, t0 = info
                 if isinstance(out, Exception):
                     # engine crashes must not count as served queries
                     errors += 1

@@ -136,5 +136,7 @@ class EnginePool:
                 out = e
             with self._results_lock:
                 self._results[qid] = out
-            self._done[qid].set()
+            # append BEFORE set(): a wait()er woken by set() must find the
+            # qid already in _completed so its remove() never races the append
             self._completed.append(qid)
+            self._done[qid].set()
