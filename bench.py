@@ -128,6 +128,85 @@ def _probe_backend(deadline_s: int = 240) -> bool:
     return False
 
 
+def watdiv_main(device_ok: bool) -> None:
+    """`bench.py --watdiv`: S1-S7/F1-F5 star/snowflake templates, batched
+    (BASELINE.json configs[3] — no published reference number for this
+    hardware, so vs_baseline is null)."""
+    from wukong_tpu.engine.cpu import CPUEngine
+    from wukong_tpu.engine.tpu import TPUEngine
+    from wukong_tpu.loader.watdiv import TEMPLATES, VirtualWatdivStrings, generate_watdiv
+    from wukong_tpu.runtime.proxy import Proxy
+    from wukong_tpu.sparql.parser import Parser
+    from wukong_tpu.planner.heuristic import heuristic_plan
+    from wukong_tpu.store.persist import load_gstore, save_gstore
+    from wukong_tpu.store.gstore import build_partition
+
+    scale = int(os.environ.get("WUKONG_WATDIV_SCALE", "0"))
+    if scale == 0:
+        scale = 28000 if os.path.exists(
+            os.path.join(CACHE, "watdiv28000_p0.npz")) else 2000
+    if not device_ok and scale > 2000:
+        scale = 2000
+    os.makedirs(CACHE, exist_ok=True)
+    store_path = os.path.join(CACHE, f"watdiv{scale}_p0.npz")
+    ss = VirtualWatdivStrings(scale, seed=0)
+    t0 = time.time()
+    if os.path.exists(store_path):
+        g = load_gstore(store_path)
+    else:
+        triples, _ = generate_watdiv(scale, seed=0)
+        g = build_partition(triples, 0, 1)
+        del triples
+        try:
+            save_gstore(g, store_path)
+        except Exception as e:
+            print(f"# store cache save failed: {e}", file=sys.stderr)
+    print(f"# watdiv-{scale} ready in {time.time() - t0:.0f}s "
+          f"({g.stats_str()})", file=sys.stderr)
+
+    eng = TPUEngine(g, ss)
+    proxy = Proxy(g, ss, CPUEngine(g, ss), eng)
+    rng = np.random.default_rng(0)
+    lat_us = []
+    details = {}
+    failed = []
+    for name in sorted(TEMPLATES):
+        try:
+            tmpl = Parser(ss).parse_template(TEMPLATES[name])
+            proxy.fill_template(tmpl)
+            cand = tmpl.candidates[0]
+            best = None
+            for _trial in range(3):
+                consts = np.asarray(
+                    cand[rng.integers(0, len(cand), BATCH)], dtype=np.int64)
+                q = tmpl.instantiate(rng)
+                heuristic_plan(q)
+                q.result.blind = True
+                t = time.perf_counter()
+                counts = eng.execute_batch(q, consts)
+                dt = (time.perf_counter() - t) * 1e6 / BATCH
+                best = dt if best is None else min(best, dt)
+            lat_us.append(best)
+            details[name] = {"us": round(best, 1), "rows": int(counts[0])}
+            print(f"# {name}: {best:,.0f} us (batch={BATCH})", file=sys.stderr)
+        except Exception as e:
+            failed.append(name)
+            details[name] = {"error": str(e)[:200]}
+            print(f"# {name}: FAILED ({e})", file=sys.stderr)
+    if not lat_us:
+        raise SystemExit("all watdiv templates failed")
+    backend = "TPU single chip" if device_ok else "cpu-fallback"
+    print(json.dumps({
+        "metric": f"WatDiv-{scale} S/F templates geomean latency, {backend},"
+                  f" blind, batch={BATCH}"
+                  + (f"; FAILED: {','.join(failed)}" if failed else ""),
+        "value": round(_geomean(lat_us), 1),
+        "unit": "us",
+        "vs_baseline": None,
+        "detail": details,
+    }))
+
+
 def main():
     device_ok = _probe_backend()
     if not device_ok:
@@ -136,6 +215,9 @@ def main():
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    if "--watdiv" in sys.argv:
+        watdiv_main(device_ok)
+        return
     scale = int(os.environ.get("WUKONG_BENCH_SCALE", "0"))
     if scale == 0:
         from wukong_tpu.loader.lubm import DATASET_VERSION

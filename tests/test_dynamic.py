@@ -111,3 +111,51 @@ def test_insert_keep_duplicates(world):
     insert_triples(g, np.asarray([[fp0, P["worksFor"], d0]], dtype=np.int64),
                    dedup=False)
     assert len(g.get_triples(fp0, P["worksFor"], OUT)) == n0 + 1
+
+
+def test_delta_segment_lazy_merge_amortized():
+    """N insert batches + 1 read = exactly ONE materialization (SURVEY §7.7:
+    delta segments + periodic merge, not O(segment) per batch)."""
+    import numpy as np
+
+    from wukong_tpu.store.dynamic import DeltaCSRSegment
+    from wukong_tpu.store.segment import CSRSegment
+
+    base = CSRSegment.from_pairs(
+        np.arange(1000, dtype=np.int64) % 100 + (1 << 17),
+        np.arange(1000, dtype=np.int64) + (1 << 18))
+    seg = DeltaCSRSegment(base)
+    merges = [0]
+    orig = DeltaCSRSegment._mat
+
+    def spy(self):
+        if self._pending:
+            merges[0] += 1
+        return orig(self)
+
+    DeltaCSRSegment._mat = spy
+    try:
+        for i in range(50):  # 50 write batches: no materialization
+            ks = np.asarray([(1 << 17) + i], dtype=np.int64)
+            vs = np.asarray([(1 << 19) + i], dtype=np.int64)
+            assert seg.append(ks, vs, dedup=True) == 1
+            assert seg.append(ks, vs, dedup=True) == 0  # delta-visible dedup
+        assert merges[0] == 0  # appends alone never merge
+        assert seg._n_pending == 50
+        assert seg.num_edges == base.num_edges + 50  # exact, no merge needed
+        assert seg._pending  # still unmerged
+
+        got = seg.lookup((1 << 17) + 3)  # first read materializes
+        assert merges[0] == 1  # exactly ONE merge for 50 write batches
+    finally:
+        DeltaCSRSegment._mat = orig
+    assert (1 << 19) + 3 in got.tolist()
+    assert not seg._pending and seg._n_pending == 0
+    # merged arrays identical to a from-scratch build
+    full_k = np.concatenate([np.repeat(base.keys, np.diff(base.offsets)),
+                             np.arange(50, dtype=np.int64) + (1 << 17)])
+    full_v = np.concatenate([base.edges,
+                             np.arange(50, dtype=np.int64) + (1 << 19)])
+    want = CSRSegment.from_pairs(full_k, full_v)
+    assert np.array_equal(seg.keys, want.keys)
+    assert np.array_equal(seg.edges, want.edges)

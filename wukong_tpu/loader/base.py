@@ -29,6 +29,15 @@ def load_triples(dataset_dir: str) -> np.ndarray:
     npy = os.path.join(dataset_dir, "id_triples.npy")
     if os.path.exists(npy):
         return np.load(npy)
+    chunks = sorted(glob.glob(os.path.join(dataset_dir, "id_triples_*.npy")))
+    if chunks:  # chunked datasets (large-scale WatDiv writer)
+        maps = [np.load(c, mmap_mode="r") for c in chunks]
+        out = np.empty((sum(len(m) for m in maps), 3), dtype=np.int64)
+        at = 0
+        for m in maps:  # streams pages from each mmap; no double-buffering
+            out[at:at + len(m)] = m
+            at += len(m)
+        return out
     files = sorted(glob.glob(os.path.join(dataset_dir, "id_*.nt")))
     if not files:
         raise FileNotFoundError(f"no id_triples.npy or id_*.nt in {dataset_dir}")

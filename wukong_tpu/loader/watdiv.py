@@ -335,10 +335,21 @@ TEMPLATES = {
 }
 
 
-def write_dataset(outdir: str, scale: int, seed: int = 0) -> dict:
+def write_dataset(outdir: str, scale: int, seed: int = 0,
+                  chunk_rows: int | None = None) -> dict:
+    """Write an id-format WatDiv dataset. `chunk_rows` splits the triple
+    array over multiple ``id_triples_<k>.npy`` files; the reader
+    (loader/base.py) preallocates and fills per chunk, so its transient
+    peak is one chunk above the dataset (the generator itself is a
+    vectorized in-RAM build either way)."""
     os.makedirs(outdir, exist_ok=True)
     triples, lay = generate_watdiv(scale, seed)
-    np.save(os.path.join(outdir, "id_triples.npy"), triples)
+    if chunk_rows:
+        for k in range(0, len(triples), chunk_rows):
+            np.save(os.path.join(outdir, f"id_triples_{k // chunk_rows:05d}.npy"),
+                    triples[k:k + chunk_rows])
+    else:
+        np.save(os.path.join(outdir, "id_triples.npy"), triples)
     with open(os.path.join(outdir, "str_index"), "w") as f:
         for s, i in index_strings():
             f.write(f"{s}\t{i}\n")

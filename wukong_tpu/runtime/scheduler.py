@@ -136,7 +136,8 @@ class EnginePool:
                 out = e
             with self._results_lock:
                 self._results[qid] = out
+                ev = self._done[qid]  # capture: a racing poll() may pop it
             # append BEFORE set(): a wait()er woken by set() must find the
             # qid already in _completed so its remove() never races the append
             self._completed.append(qid)
-            self._done[qid].set()
+            ev.set()

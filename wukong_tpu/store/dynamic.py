@@ -4,9 +4,10 @@ The reference's dynamic store (core/store/dynamic_gstore.hpp) swaps the bump
 allocator for a real allocator so `load -d <dir>` can insert triples online
 (insert_triple_out/in, :537/:603), with lease-based invalidation so remote
 RDMA-cached reads stay safe. On TPU the RDMA lease machinery disappears
-(SURVEY §7.7): instead each insert batch merge-rebuilds the affected CSR
-segments (sorted-merge, optional dedup like the reference's -c flag) and bumps
-a store version; device-side caches compare versions and restage lazily.
+(SURVEY §7.7): inserts append to per-segment DELTA buffers (O(batch) plus a
+membership probe for dedup — never an O(segment) rebuild per batch), and the
+merged CSR materializes lazily on first read after a write epoch. Each batch
+bumps a store version; device-side caches compare versions and restage lazily.
 
 New predicates/types create new segments/indexes, matching DynamicLoader's
 support for unseen predicates (core/loader/dynamic_loader.hpp).
@@ -20,6 +21,101 @@ from wukong_tpu.store.gstore import GStore, _pred_runs, _triple_argsort
 from wukong_tpu.store.segment import CSRSegment
 from wukong_tpu.types import IN, NORMAL_ID_START, OUT, TYPE_ID
 from wukong_tpu.utils.mathutil import hash_mod
+
+
+class DeltaCSRSegment:
+    """CSR segment with append-only delta buffers (dynamic_gstore.hpp's role,
+    redesigned): writes append (key, value) runs; reads materialize the
+    merged CSR once per write epoch. Duck-types CSRSegment — every consumer
+    (engines, device staging, checker, persistence) sees merged arrays.
+    """
+
+    __slots__ = ("_base", "_pending", "_n_pending", "_pending_set")
+
+    def __init__(self, base: CSRSegment | None):
+        self._base = base if base is not None else CSRSegment.empty()
+        self._pending: list = []
+        self._n_pending = 0
+        self._pending_set: set = set()  # O(1) dedup probes into the deltas
+
+    # ---- writes ----------------------------------------------------------
+    def append(self, ks: np.ndarray, vs: np.ndarray, dedup: bool) -> int:
+        """Append a batch; with dedup, pairs already present (in the base,
+        the pending deltas, or earlier in the batch) are dropped. O(batch)
+        plus a base membership probe — never re-scans prior deltas. Returns
+        the number of edges actually appended."""
+        if dedup:
+            if len(ks):
+                pairs = np.stack([ks, vs], axis=1)
+                pairs = np.unique(pairs, axis=0)  # in-batch dups
+                ks, vs = pairs[:, 0], pairs[:, 1]
+            keep = ~self._base.contains_pair(ks, vs)
+            if self._pending_set:
+                ps = self._pending_set
+                keep &= np.fromiter(
+                    ((int(k), int(v)) not in ps for k, v in zip(ks, vs)),
+                    dtype=bool, count=len(ks))
+            ks, vs = ks[keep], vs[keep]
+        if len(ks):
+            ks = np.asarray(ks, np.int64)
+            vs = np.asarray(vs, np.int64)
+            self._pending.append((ks, vs))
+            self._n_pending += len(ks)
+            self._pending_set.update(zip(ks.tolist(), vs.tolist()))
+        return int(len(ks))
+
+    # ---- lazy materialization -------------------------------------------
+    def _mat(self) -> CSRSegment:
+        if self._pending:
+            bk = np.repeat(self._base.keys, np.diff(self._base.offsets))
+            all_k = np.concatenate([bk] + [p[0] for p in self._pending])
+            all_v = np.concatenate([self._base.edges]
+                                   + [p[1] for p in self._pending])
+            order = np.lexsort((all_v, all_k))
+            k, v = all_k[order], all_v[order]
+            keys, counts = np.unique(k, return_counts=True)
+            offsets = np.zeros(len(keys) + 1, dtype=np.int64)
+            np.cumsum(counts, out=offsets[1:])
+            # no pair-dedup here: dedup appends were filtered at write time,
+            # non-dedup appends legitimately keep duplicates
+            self._base = CSRSegment(keys=keys, offsets=offsets, edges=v)
+            self._pending.clear()
+            self._pending_set.clear()
+            self._n_pending = 0
+        return self._base
+
+    # ---- CSRSegment interface -------------------------------------------
+    @property
+    def keys(self):
+        return self._mat().keys
+
+    @property
+    def offsets(self):
+        return self._mat().offsets
+
+    @property
+    def edges(self):
+        return self._mat().edges
+
+    @property
+    def num_keys(self) -> int:
+        return self._mat().num_keys
+
+    @property
+    def num_edges(self) -> int:  # exact without materializing
+        return self._base.num_edges + self._n_pending
+
+    def lookup(self, vid: int):
+        return self._mat().lookup(vid)
+
+    def lookup_many(self, vids):
+        return self._mat().lookup_many(vids)
+
+    def contains_pair(self, vids, vals):
+        return self._mat().contains_pair(vids, vals)
+
+    def memory_bytes(self) -> int:
+        return self._base.memory_bytes() + 16 * self._n_pending
 
 
 def insert_triples(g: GStore, triples: np.ndarray, dedup: bool = True,
@@ -79,28 +175,18 @@ def insert_triples(g: GStore, triples: np.ndarray, dedup: bool = True,
 
 def _merge_into(g: GStore, key, ks, vs, dedup: bool) -> int:
     seg = g.segments.get(key)
-    before = seg.num_edges if seg is not None else 0
-    g.segments[key] = _merge_seg(seg, ks, vs, dedup)
-    return g.segments[key].num_edges - before  # actual new edges (post-dedup)
+    if not isinstance(seg, DeltaCSRSegment):
+        seg = DeltaCSRSegment(seg)
+        g.segments[key] = seg
+    return seg.append(np.asarray(ks, np.int64), np.asarray(vs, np.int64),
+                      dedup)
 
 
-def _merge_seg(seg: CSRSegment | None, ks, vs, dedup: bool) -> CSRSegment:
-    if seg is None or seg.num_edges == 0:
-        base_k = np.asarray(ks)
-        base_v = np.asarray(vs)
-        all_k, all_v = base_k, base_v
-    else:
-        old_k = np.repeat(seg.keys, np.diff(seg.offsets))
-        all_k = np.concatenate([old_k, ks])
-        all_v = np.concatenate([seg.edges, vs])
-    if not dedup:
-        order = np.lexsort((all_v, all_k))
-        k, v = all_k[order], all_v[order]
-        keys, counts = np.unique(k, return_counts=True)
-        offsets = np.zeros(len(keys) + 1, dtype=np.int64)
-        np.cumsum(counts, out=offsets[1:])
-        return CSRSegment(keys=keys, offsets=offsets, edges=v)
-    return CSRSegment.from_pairs(all_k, all_v)  # sorts + dedups pairs
+def _merge_seg(seg, ks, vs, dedup: bool) -> DeltaCSRSegment:
+    if not isinstance(seg, DeltaCSRSegment):
+        seg = DeltaCSRSegment(seg)
+    seg.append(np.asarray(ks, np.int64), np.asarray(vs, np.int64), dedup)
+    return seg
 
 
 def load_dir_into(stores: list[GStore], dirname: str, dedup: bool = True) -> int:
