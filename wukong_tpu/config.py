@@ -43,6 +43,7 @@ class GlobalConfig:
     stealing_pattern: int = 0  # 0: pair, 1: ring (host engine work stealing)
     enable_budget: bool = True
     gpu_enable_pipeline: bool = True  # prefetch next pattern's segments to HBM
+    enable_pallas: bool = True  # Pallas probe kernel on TPU backends
 
     # ---- TPU-engine knobs (new; no reference analogue) ----
     table_capacity_min: int = 1024  # smallest binding-table capacity class

@@ -214,10 +214,11 @@ class TPUEngine:
             est = self._estimate_rows(state, pat, seg)
             cap_out = cap_override.get(step) or K.next_capacity(
                 max(est, self.cap_min), self.cap_min, self.cap_max)
-            out, nn, total = K.expand(state.table, state.n, seg.bkey,
-                                      seg.bstart, seg.bdeg, seg.edges,
-                                      col=col, cap_out=cap_out,
-                                      max_probe=seg.max_probe)
+            out, nn, total = K.expand(
+                state.table, state.n, seg.bkey, seg.bstart, seg.bdeg,
+                seg.edges, col=col, cap_out=cap_out,
+                max_probe=seg.max_probe,
+                use_pallas=K.want_pallas(seg.bkey, state.table.shape[1]))
             state.advance_expand(out, nn, end, total, cap_out, step,
                                  est_rows=min(est, cap_out))
         else:  # known_to_known / known_to_const
@@ -228,11 +229,11 @@ class TPUEngine:
                     vals = state.table[e_col]
                 else:
                     vals = jnp.full(state.table.shape[1], np.int32(end))
-                keep = K.member_mask_known(state.table, state.n, vals,
-                                           seg.bkey, seg.bstart,
-                                           seg.bdeg, seg.edges, col=col,
-                                           max_probe=seg.max_probe,
-                                           depth=seg.max_deg_log2)
+                keep = K.member_mask_known(
+                    state.table, state.n, vals, seg.bkey, seg.bstart,
+                    seg.bdeg, seg.edges, col=col, max_probe=seg.max_probe,
+                    depth=seg.max_deg_log2,
+                    use_pallas=K.want_pallas(seg.bkey, state.table.shape[1]))
             out, nn = K.compact(state.table, keep)
             state.advance_filter(out, nn)
 

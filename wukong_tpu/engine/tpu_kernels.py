@@ -39,6 +39,118 @@ BUCKET = 8
 
 
 # ---------------------------------------------------------------------------
+# Pallas probe kernel: bucket tables resident in VMEM, fused lane reduction
+# (the gpu_hash.cu:149-260 slot-probe role). Activated on real TPU backends
+# for segments whose bucket tables fit the VMEM budget; everything else uses
+# the XLA gather path below. Validated in interpret mode on CPU.
+# ---------------------------------------------------------------------------
+
+_PROBE_TILE = 1024
+_PALLAS_VMEM_BUDGET = 12 << 20  # bytes of bucket table kept VMEM-resident
+_pallas_state = {"ok": None}  # None = not probed yet
+
+
+def pallas_available() -> bool:
+    """One-time capability probe: compiles and runs a REAL (tiny) instance of
+    pallas_probe on the current backend, exercising the grid, the SMEM
+    scalar, and the dynamic 1-D gathers it depends on. Any failure
+    permanently selects the XLA path."""
+    if _pallas_state["ok"] is None:
+        try:
+            import jax
+
+            if jax.devices()[0].platform != "tpu":
+                _pallas_state["ok"] = False
+            else:
+                nbs = 8 * 128
+                bkey = jnp.full((nbs,), -1, jnp.int32)
+                zero = jnp.zeros((nbs,), jnp.int32)
+                cur = jnp.zeros((_PROBE_TILE,), jnp.int32)
+                f, s, d = pallas_probe(bkey, zero, zero, cur,
+                                       jnp.int32(1), max_probe=1)
+                jax.device_get((f, s, d))
+                _pallas_state["ok"] = True
+        except Exception:
+            _pallas_state["ok"] = False
+    return _pallas_state["ok"]
+
+
+def want_pallas(bkey, capacity: int) -> bool:
+    """Caller-side (outside jit) dispatch decision — passed into the kernels
+    as a STATIC argument so it is part of the jit cache key (toggling
+    Global.enable_pallas at runtime takes effect immediately)."""
+    from wukong_tpu.config import Global
+
+    if not getattr(Global, "enable_pallas", True):
+        return False
+    nb_bytes = int(bkey.shape[0]) * 4 * 3
+    return (bkey.shape[0] >= 8 * 128
+            and nb_bytes <= _PALLAS_VMEM_BUDGET
+            and capacity % _PROBE_TILE == 0
+            and pallas_available())
+
+
+def pallas_probe(bkey, bstart, bdeg, cur, n, max_probe: int,
+                 interpret: bool = False):
+    """(found, start, degree) per cur[i] — the _hash_find contract, as a
+    Pallas kernel: the three bucket arrays stay VMEM-resident across a grid
+    of row tiles, so every probe round's 8-lane reduction gathers from VMEM
+    instead of HBM."""
+    import jax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    C = cur.shape[0]
+    NBS = bkey.shape[0]
+    NB = NBS // BUCKET
+    bmask = np.uint32(NB - 1)
+
+    def kernel(n_ref, bkey_ref, bstart_ref, bdeg_ref, cur_ref,
+               found_ref, start_ref, deg_ref):
+        i = pl.program_id(0)
+        cur_v = cur_ref[0, :]
+        bk = bkey_ref[0, :]
+        bs = bstart_ref[0, :]
+        bd = bdeg_ref[0, :]
+        hb = (cur_v.astype(jnp.uint32) * _HASH_MULT) & bmask
+        found = jnp.zeros((_PROBE_TILE,), jnp.bool_)
+        start = jnp.zeros((_PROBE_TILE,), jnp.int32)
+        deg = jnp.zeros((_PROBE_TILE,), jnp.int32)
+        for r in range(max_probe):
+            rows = (((hb + np.uint32(r)) & bmask).astype(jnp.int32) * BUCKET)
+            for lane in range(BUCKET):
+                idx = rows + lane
+                kk = jnp.take(bk, idx)  # idx always in-bounds by masking
+                pick = (kk == cur_v) & (~found)
+                start = jnp.where(pick, jnp.take(bs, idx), start)
+                deg = jnp.where(pick, jnp.take(bd, idx), deg)
+                found = found | pick
+        j = (i * _PROBE_TILE
+             + jax.lax.broadcasted_iota(jnp.int32, (1, _PROBE_TILE), 1)[0])
+        ok = found & (j < n_ref[0])
+        found_ref[0, :] = ok.astype(jnp.int32)
+        start_ref[0, :] = jnp.where(ok, start, 0)
+        deg_ref[0, :] = jnp.where(ok, deg, 0)
+
+    whole = pl.BlockSpec((1, NBS), lambda i: (0, 0), memory_space=pltpu.VMEM)
+    tile = pl.BlockSpec((1, _PROBE_TILE), lambda i: (0, i),
+                        memory_space=pltpu.VMEM)
+    f, s, d = pl.pallas_call(
+        kernel,
+        grid=(C // _PROBE_TILE,),
+        out_shape=(jax.ShapeDtypeStruct((1, C), jnp.int32),
+                   jax.ShapeDtypeStruct((1, C), jnp.int32),
+                   jax.ShapeDtypeStruct((1, C), jnp.int32)),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  whole, whole, whole, tile],
+        out_specs=(tile, tile, tile),
+        interpret=interpret,
+    )(jnp.asarray([n], jnp.int32), bkey[None], bstart[None], bdeg[None],
+      cur[None])
+    return f[0].astype(jnp.bool_), s[0], d[0]
+
+
+# ---------------------------------------------------------------------------
 # hashed CSR lookup (flat bucket arrays)
 # ---------------------------------------------------------------------------
 
@@ -104,8 +216,20 @@ def _range_member(edges, lo, hi, vals, depth: int):
 # ---------------------------------------------------------------------------
 
 
-@partial(jax.jit, static_argnames=("col", "cap_out", "max_probe"))
-def expand(table, n, bkey, bstart, bdeg, edges, col, cap_out, max_probe):
+def _probe(bkey, bstart, bdeg, cur, n, max_probe: int, use_pallas: bool):
+    """Probe dispatch. `use_pallas` is the caller's STATIC decision (see
+    want_pallas); row validity is derived from `n` on both paths so the two
+    backends can never diverge on masking."""
+    if use_pallas:
+        return pallas_probe(bkey, bstart, bdeg, cur, n, max_probe)
+    valid = jnp.arange(cur.shape[0], dtype=jnp.int32) < n
+    return _hash_find(bkey, bstart, bdeg, cur, valid, max_probe)
+
+
+@partial(jax.jit,
+         static_argnames=("col", "cap_out", "max_probe", "use_pallas"))
+def expand(table, n, bkey, bstart, bdeg, edges, col, cap_out, max_probe,
+           use_pallas=False):
     """known_to_unknown: expand each live row by its neighbor list.
 
     table: [W, C]. Returns (out [W+1, cap_out], out_n, total) — total may
@@ -116,7 +240,8 @@ def expand(table, n, bkey, bstart, bdeg, edges, col, cap_out, max_probe):
     rows = jnp.arange(C, dtype=jnp.int32)
     valid = rows < n
     cur = table[col]
-    found, start, deg = _hash_find(bkey, bstart, bdeg, cur, valid, max_probe)
+    found, start, deg = _probe(bkey, bstart, bdeg, cur, n, max_probe,
+                               use_pallas)
     cum = jnp.cumsum(deg)
     total = cum[C - 1]
     starts_excl = cum - deg
@@ -136,16 +261,18 @@ def expand(table, n, bkey, bstart, bdeg, edges, col, cap_out, max_probe):
     return out, jnp.minimum(total, cap_out).astype(jnp.int32), total
 
 
-@partial(jax.jit, static_argnames=("col", "max_probe", "depth"))
+@partial(jax.jit,
+         static_argnames=("col", "max_probe", "depth", "use_pallas"))
 def member_mask_known(table, n, vals, bkey, bstart, bdeg, edges,
-                      col, max_probe, depth):
+                      col, max_probe, depth, use_pallas=False):
     """known_to_known / known_to_const: per-row membership of vals[i] in
     adj(cur[i]). table: [W, C]; vals: [C]."""
     W, C = table.shape
     rows = jnp.arange(C, dtype=jnp.int32)
     valid = rows < n
     cur = table[col]
-    found, start, deg = _hash_find(bkey, bstart, bdeg, cur, valid, max_probe)
+    found, start, deg = _probe(bkey, bstart, bdeg, cur, n, max_probe,
+                               use_pallas)
     ok = _range_member(edges, start, start + deg, vals, depth)
     return valid & found & ok
 
