@@ -4,8 +4,9 @@ Mirrors the reference loader pipeline (core/loader/base_loader.hpp +
 posix_loader.hpp): read ID-triple files from a dataset directory, partition by
 hash(vid) % num_workers on both subject and object, and hand sorted runs to the
 store builder. The reference's RDMA shuffle (read_partial_exchange,
-base_loader.hpp:165-219) collapses into in-process numpy selection; multi-host
-sharded loading arrives with the DCN launch path.
+base_loader.hpp:165-219) collapses into in-process numpy selection; for
+multi-host runs the shuffle moves OFFLINE (preshard_dataset) so each host's
+online load reads only its own file (load_host_partitions).
 
 Supported inputs:
 - ``id_*.nt`` text files of "s\\tp\\to" rows (reference format)
@@ -63,6 +64,76 @@ def load_attr_triples(dataset_dir: str) -> list[tuple]:
                 v = float(parts[3]) if t in (2, 3) else int(parts[3])
                 rows.append((s, a, t, v))
     return rows
+
+
+# ---------------------------------------------------------------------------
+# multi-host loading: preshard offline, then each host loads only its file
+# (the reference's read_partial_exchange RDMA shuffle — base_loader.hpp:165-219
+# — moved offline: with no host-side RDMA, the shuffle becomes a one-time
+# re-bucketing of the dataset so the online load is embarrassingly parallel)
+# ---------------------------------------------------------------------------
+
+
+def preshard_dataset(src_dir: str, out_dir: str, num_hosts: int,
+                     shards_per_host: int) -> dict:
+    """Re-bucket an id-dataset into per-host files: host h's file holds every
+    triple whose subject OR object owner falls in h's shard range (the
+    both-sides placement invariant, base_loader.hpp:172-173), so each host
+    can build its local partitions from its own file alone."""
+    from wukong_tpu.utils.mathutil import hash_mod
+
+    os.makedirs(out_dir, exist_ok=True)
+    triples = load_triples(src_dir)
+    total = num_hosts * shards_per_host
+    s_host = hash_mod(triples[:, 0], total) // shards_per_host
+    o_host = hash_mod(triples[:, 2], total) // shards_per_host
+    sizes = {}
+    for h in range(num_hosts):
+        rows = triples[(s_host == h) | (o_host == h)]
+        np.save(os.path.join(out_dir, f"host{h:03d}_triples.npy"), rows)
+        sizes[h] = int(len(rows))
+    import shutil
+
+    for aux in ("str_index", "str_attr_index", "str_normal",
+                "str_normal_virtual"):
+        src = os.path.join(src_dir, aux)
+        if os.path.exists(src):
+            shutil.copyfile(src, os.path.join(out_dir, aux))
+    # attribute triples ride along whole (attrs are subject-owner-placed;
+    # build_partition filters per shard) — dropping them would silently
+    # zero attribute queries on the presharded cluster
+    for apath in sorted(glob.glob(os.path.join(src_dir, "attr_*.nt"))):
+        shutil.copyfile(apath,
+                        os.path.join(out_dir, os.path.basename(apath)))
+    meta = {"num_hosts": num_hosts, "shards_per_host": shards_per_host,
+            "rows_per_host": sizes}
+    import json
+
+    with open(os.path.join(out_dir, "preshard.json"), "w") as f:
+        json.dump(meta, f)
+    return meta
+
+
+def load_host_partitions(presharded_dir: str, host_id: int,
+                         versatile: bool = True) -> list[GStore]:
+    """One host's bulk load: read only this host's triple file (plus the
+    shared attr files), build its local shard range. The returned stores
+    carry GLOBAL shard ids (sid), ready to sit under the host's mesh slice."""
+    import json
+
+    with open(os.path.join(presharded_dir, "preshard.json")) as f:
+        meta = json.load(f)
+    sph = meta["shards_per_host"]
+    total = meta["num_hosts"] * sph
+    rows = np.load(os.path.join(presharded_dir,
+                                f"host{host_id:03d}_triples.npy"))
+    attrs = load_attr_triples(presharded_dir)
+    from wukong_tpu.store.gstore import check_vid_range
+
+    check_vid_range(rows)
+    return [build_partition(rows, host_id * sph + k, total, attrs,
+                            versatile, check_ids=False)
+            for k in range(sph)]
 
 
 def load_dataset(dataset_dir: str, num_workers: int,
