@@ -207,6 +207,93 @@ def watdiv_main(device_ok: bool) -> None:
     }))
 
 
+def dbpedia_main(device_ok: bool) -> None:
+    """`bench.py --dbpedia`: DBpedia-shaped mixed L/C/F workload with the
+    type-centric planner on (BASELINE.json configs[4]). Queries are built in
+    id space from the synthesizer's metadata, mirroring the dbpsb shapes
+    (type + property stars, hub anchors, type-filtered chains); vs_baseline
+    is null (no published reference number for this hardware)."""
+    from wukong_tpu.engine.tpu import TPUEngine
+    from wukong_tpu.loader.generic_rdf import generate_generic
+    from wukong_tpu.planner.optimizer import Planner
+    from wukong_tpu.planner.stats import Stats
+    from wukong_tpu.sparql.ir import Pattern, SPARQLQuery
+    from wukong_tpu.types import OUT, TYPE_ID
+
+    n_ent = int(os.environ.get("WUKONG_DBPEDIA_ENTITIES", "0")) or \
+        (2_000_000 if device_ok else 100_000)
+    t0 = time.time()
+    triples, meta = generate_generic(n_ent, n_preds=200, n_types=50, seed=1)
+    from wukong_tpu.store.gstore import build_partition
+
+    g = build_partition(triples, 0, 1)
+    stats = Stats.generate(triples)
+    planner = Planner(stats)
+    print(f"# dbpedia-shaped world ({len(triples):,} triples) ready "
+          f"in {time.time() - t0:.0f}s", file=sys.stderr)
+    eng = TPUEngine(g, None, stats=stats)
+    pids = sorted(stats.pred_edges, key=lambda p: -stats.pred_edges[p])
+    pids = [p for p in pids if p != TYPE_ID][:6]
+    types = sorted((t for t in stats.tyscount if t > 0),
+                   key=lambda t: -stats.tyscount[t])[:4]
+    hub = int(meta["hubs"][0])
+
+    def mk(pats, nvars):
+        q = SPARQLQuery()
+        q.pattern_group.patterns = [Pattern(*p) for p in pats]
+        q.result.nvars = nvars
+        q.result.required_vars = [-(i + 1) for i in range(nvars)]
+        q.result.blind = True
+        return q
+
+    cases = {
+        # L: type + property star (dbpsb_q1 shape)
+        "L1": mk([(-1, TYPE_ID, OUT, types[0]), (-1, pids[0], OUT, -2)], 2),
+        # C: type-filtered 2-hop chain
+        "C1": mk([(-1, TYPE_ID, OUT, types[1]), (-1, pids[1], OUT, -2),
+                  (-2, pids[2], OUT, -3)], 3),
+        # F: hub anchor + expansion (skew stress)
+        "F1": mk([(-1, pids[0], OUT, hub), (-1, pids[3], OUT, -2)], 2),
+    }
+    lat_us, details, failed = [], {}, []
+    for name, q0 in cases.items():
+        try:
+            import copy
+
+            best = None
+            nrows = -1
+            for _trial in range(3):
+                q = copy.deepcopy(q0)
+                if not planner.generate_plan(q):
+                    raise RuntimeError("planner failed to produce a plan")
+                t = time.perf_counter()
+                eng.execute(q, from_proxy=False)
+                dt = (time.perf_counter() - t) * 1e6
+                if q.result.status_code != 0:
+                    raise RuntimeError(f"status {q.result.status_code!r}")
+                nrows = q.result.nrows
+                best = dt if best is None else min(best, dt)
+            lat_us.append(best)
+            details[name] = {"us": round(best, 1), "rows": nrows}
+            print(f"# {name}: {best:,.0f} us (rows={nrows})", file=sys.stderr)
+        except Exception as e:
+            failed.append(name)
+            details[name] = {"error": str(e)[:200]}
+            print(f"# {name}: FAILED ({e})", file=sys.stderr)
+    if not lat_us:
+        raise SystemExit("all dbpedia cases failed")
+    backend = "TPU single chip" if device_ok else "cpu-fallback"
+    print(json.dumps({
+        "metric": f"DBpedia-shaped ({len(triples):,} triples) mixed L/C/F "
+                  f"geomean latency, {backend}, planner on"
+                  + (f"; FAILED: {','.join(failed)}" if failed else ""),
+        "value": round(_geomean(lat_us), 1),
+        "unit": "us",
+        "vs_baseline": None,
+        "detail": details,
+    }))
+
+
 def main():
     device_ok = _probe_backend()
     if not device_ok:
@@ -217,6 +304,9 @@ def main():
         jax.config.update("jax_platforms", "cpu")
     if "--watdiv" in sys.argv:
         watdiv_main(device_ok)
+        return
+    if "--dbpedia" in sys.argv:
+        dbpedia_main(device_ok)
         return
     scale = int(os.environ.get("WUKONG_BENCH_SCALE", "0"))
     if scale == 0:
