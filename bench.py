@@ -294,8 +294,29 @@ def dbpedia_main(device_ok: bool) -> None:
     }))
 
 
+def _setup_jax_caches() -> None:
+    """Persistent XLA compilation cache: the axon-tunneled backend compiles
+    slowly (tens of seconds per program), so repeated bench runs must reuse
+    compiled programs across processes."""
+    import jax
+
+    try:
+        cache_dir = os.path.join(CACHE, "xla")
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception as e:
+        print(f"# compilation cache unavailable: {e}", file=sys.stderr)
+
+
 def main():
     device_ok = _probe_backend()
+    _setup_jax_caches()
+    if os.environ.get("WUKONG_ENABLE_PALLAS", "1") == "0":
+        from wukong_tpu.config import Global
+
+        Global.enable_pallas = False
+        print("# pallas disabled via WUKONG_ENABLE_PALLAS=0", file=sys.stderr)
     if not device_ok:
         # sitecustomize already registered the axon plugin at startup; the
         # config update (not env vars) is what pins the CPU backend now.
@@ -337,6 +358,8 @@ def main():
     details = {}
     failed = []
     for i, qn in enumerate([f"lubm_q{k}" for k in range(1, 8)]):
+        print(f"# [{time.strftime('%H:%M:%S')}] {qn} starting",
+              file=sys.stderr, flush=True)
         text = open(f"{BASIC}/{qn}").read()
         q0 = Parser(ss).parse(text)
         heuristic_plan(q0)

@@ -1,0 +1,94 @@
+"""Core binding (reference: core/bind.hpp — topology, core.bind, setaffinity)."""
+
+import os
+
+import pytest
+
+from wukong_tpu.runtime.bind import CoreBinder, _parse_cpulist
+
+
+def test_parse_cpulist():
+    assert _parse_cpulist("0-3,8,10-11") == [0, 1, 2, 3, 8, 10, 11]
+    assert _parse_cpulist("0\n") == [0]
+    assert _parse_cpulist("") == []
+
+
+def test_topology_discovered():
+    b = CoreBinder()
+    assert b.num_cores >= 1
+    assert len(b.cpu_topo) >= 1
+    # default bindings cover every discovered core exactly once
+    assert sorted(b.default_bindings) == sorted(
+        c for node in b.cpu_topo for c in node)
+    assert b.core_of(0) == b.default_bindings[0]
+    # round-robin wrap
+    assert b.core_of(b.num_cores) == b.default_bindings[0]
+
+
+def test_core_bind_file(tmp_path):
+    b = CoreBinder()
+    # synthetic 2-node topology (the reference cluster shape, bind.hpp:37-61)
+    b.cpu_topo = [[0, 2, 4], [1, 3, 5]]
+    b.default_bindings = [0, 2, 4, 1, 3, 5]
+    f = tmp_path / "core.bind"
+    f.write_text("# comment\n0 1 4\n2 3\n")
+    assert b.load_core_binding(str(f))
+    assert b.enabled
+    # line 1 -> node 0 cores in order; line 2 -> node 1
+    assert b.core_bindings[0] == 0
+    assert b.core_bindings[1] == 2
+    assert b.core_bindings[4] == 4
+    assert b.core_bindings[2] == 1
+    assert b.core_bindings[3] == 3
+    # unmapped tid falls back to default round-robin
+    assert b.core_of(5) == b.default_bindings[5]
+
+
+def test_core_bind_missing_file():
+    b = CoreBinder()
+    assert not b.load_core_binding("/nonexistent/core.bind")
+    assert not b.enabled
+
+
+@pytest.mark.skipif(not hasattr(os, "sched_setaffinity"),
+                    reason="no sched_setaffinity on this platform")
+def test_bind_and_unbind_roundtrip():
+    b = CoreBinder()
+    before = b.get_core_binding()
+    if b.num_cores > 1:
+        b.enabled = True
+        assert b.bind_thread(0)
+        assert b.get_core_binding() == {b.core_of(0)}
+    else:
+        # single-core host: binding is a documented no-op
+        assert not b.bind_thread(0)
+    b.bind_to_all()
+    assert b.get_core_binding() == set(b.default_bindings) or not before
+
+
+def test_engine_pool_binds_threads(monkeypatch):
+    """EnginePool threads call bind_thread(tid) on startup."""
+    import wukong_tpu.runtime.bind as bind_mod
+    from wukong_tpu.runtime.scheduler import EnginePool
+
+    seen = []
+
+    class FakeBinder:
+        def bind_thread(self, tid):
+            seen.append(tid)
+            return True
+
+    monkeypatch.setattr(bind_mod, "_binder", FakeBinder())
+
+    class Echo:
+        def execute(self, q):
+            return q
+
+    pool = EnginePool(num_engines=2, make_engine=lambda tid: Echo())
+    pool.start()
+    try:
+        qid = pool.submit("x")
+        assert pool.wait(qid, timeout=5) == "x"
+    finally:
+        pool.stop()
+    assert sorted(seen) == [0, 1]

@@ -54,6 +54,41 @@ class TPUEngine:
         self.cpu = CPUEngine(gstore, str_server)
         self.cap_min = Global.table_capacity_min
         self.cap_max = Global.table_capacity_max
+        self._est_planner = None  # lazy Planner over self.stats
+        self._est_cache: dict = {}  # pattern-tuple -> {step: rows}
+
+    # estimate safety factor: one capacity class of headroom. Kernels pay for
+    # CAPACITY, not live rows (a 2x over-provision doubles every gather), so
+    # tight classes + overflow-retry beat compounding safety margins.
+    EST_SAFETY = 2.0
+
+    def _chain_estimates(self, patterns) -> dict[int, float]:
+        """Per-step row estimates {step: rows} from the planner's joint
+        type-table walk (optimizer.estimate_chain); empty when stats are
+        absent or the chain shape defeats estimation. Memoized per pattern
+        list — the emulator re-dispatches the same template thousands of
+        times."""
+        if self.stats is None:
+            return {}
+        key = tuple((p.subject, p.predicate, int(p.direction), p.object)
+                    for p in patterns)
+        cached = self._est_cache.get(key)
+        if cached is not None:
+            return cached
+        if self._est_planner is None:
+            from wukong_tpu.planner.optimizer import Planner
+
+            self._est_planner = Planner(self.stats)
+        try:
+            ests = self._est_planner.estimate_chain(list(patterns))
+        except Exception:
+            ests = None
+        out = ({} if ests is None
+               else {k: max(float(e), 1.0) for k, e in enumerate(ests)})
+        if len(self._est_cache) > 4096:
+            self._est_cache.clear()
+        self._est_cache[key] = out
+        return out
 
     # ------------------------------------------------------------------
     def execute(self, q: SPARQLQuery, from_proxy: bool = True) -> SPARQLQuery:
@@ -128,8 +163,11 @@ class TPUEngine:
                     and not q.pattern_group.optional
                     and not q.pattern_group.filters)
         cap_override: dict[int, int] = {}
+        step_est = (self._chain_estimates(q.pattern_group.patterns)
+                    if q.pattern_step == 0 else {})
         for _attempt in range(8):
-            state = self._dispatch_chain(q, device_steps, cap_override)
+            state = self._dispatch_chain(q, device_steps, cap_override,
+                                         step_est)
             host_table, n, totals = state.sync(blind=blind_ok)
             over = [s for s, t, c in totals if t > c]
             if not over:
@@ -159,10 +197,12 @@ class TPUEngine:
             q.local_var = state.local_var
 
     def _dispatch_chain(self, q: SPARQLQuery, device_steps: int,
-                        cap_override: dict) -> "_ChainState":
+                        cap_override: dict,
+                        step_est: dict | None = None) -> "_ChainState":
         import jax.numpy as jnp
 
         state = _ChainState(q.result)
+        state.step_est = step_est or {}
         for k in range(device_steps):
             step = q.pattern_step + k
             pat = q.get_pattern(step)
@@ -211,7 +251,7 @@ class TPUEngine:
             if seg is None:
                 state.append_empty_col(end)
                 return
-            est = self._estimate_rows(state, pat, seg)
+            est = self._estimate_rows(state, pat, seg, step=step)
             cap_out = cap_override.get(step) or K.next_capacity(
                 max(est, self.cap_min), self.cap_min, self.cap_max)
             out, nn, total = K.expand(
@@ -234,8 +274,22 @@ class TPUEngine:
                     seg.bdeg, seg.edges, col=col, max_probe=seg.max_probe,
                     depth=seg.max_deg_log2,
                     use_pallas=K.want_pallas(seg.bkey, state.table.shape[1]))
-            out, nn = K.compact(state.table, keep)
-            state.advance_filter(out, nn)
+            C = state.table.shape[1]
+            se = state.step_est.get(step)
+            cap_new = cap_override.get(step)
+            if cap_new is None and se is not None:
+                cap_new = K.next_capacity(
+                    max(int(se * self.EST_SAFETY), self.cap_min),
+                    self.cap_min, self.cap_max)
+            if cap_new is not None and cap_new < C:
+                # estimate-driven shrink: totals ride-along so an
+                # underestimate retries the chain, never drops rows
+                out, nn, total = K.compact_to(state.table, keep, cap_new)
+                state.advance_filter(out, nn)
+                state.totals.append((step, total, cap_new))
+            else:
+                out, nn = K.compact(state.table, keep)
+                state.advance_filter(out, nn)
 
     # ------------------------------------------------------------------
     # batched execution: one compiled chain answers B template instances
@@ -284,7 +338,7 @@ class TPUEngine:
             state.est_rows = B
             return 0  # dispatch every pattern (the const col pre-binds step 0)
 
-        return self._run_batch_chain(q, B, make_init)
+        return self._run_batch_chain(q, B, make_init, est_mult=float(B))
 
     def execute_batch_index(self, q: SPARQLQuery, B: int,
                             slice_mode: bool = False) -> np.ndarray:
@@ -335,12 +389,16 @@ class TPUEngine:
             state.est_rows = max(total0, 1)
             return 1  # pattern 0 is consumed by the init
 
-        return self._run_batch_chain(q, B, make_init)
+        return self._run_batch_chain(q, B, make_init,
+                                     est_mult=1.0 if slice_mode else float(B))
 
-    def _run_batch_chain(self, q: SPARQLQuery, B: int, make_init) -> np.ndarray:
+    def _run_batch_chain(self, q: SPARQLQuery, B: int, make_init,
+                         est_mult: float = 1.0) -> np.ndarray:
         import jax
 
         pats = q.pattern_group.patterns
+        step_est = {k: e * est_mult
+                    for k, e in self._chain_estimates(pats).items()}
         pins = [(p.predicate, p.direction) for p in pats if p.predicate > 0]
         self.dstore.pin(pins)
         if Global.gpu_enable_pipeline:
@@ -351,6 +409,7 @@ class TPUEngine:
             cap_override: dict[int, int] = {}
             for _attempt in range(8):
                 state = _ChainState(q.result)
+                state.step_est = step_est
                 first = make_init(state, cap_override)
                 for k in range(first, len(pats)):
                     pat = q.get_pattern(k)
@@ -384,18 +443,24 @@ class TPUEngine:
         pats = q.pattern_group.patterns
         if not pats or not q.start_from_index():
             return 1
-        peak = est = max(len(self.g.get_index(pats[0].subject,
-                                              pats[0].direction)), 1)
-        bound = {pats[0].object}
-        for pat in pats[1:]:
-            if pat.object < 0 and pat.object not in bound \
-                    and pat.subject in bound:
-                # a genuine expansion; member/k2k steps only shrink
-                est = int(est * self._fanout(pat)) or 1
-                peak = max(peak, est)
-                bound.add(pat.object)
+        ests = self._chain_estimates(pats)
+        if ests:
+            peak = max(max(ests.values()),
+                       len(self.g.get_index(pats[0].subject,
+                                            pats[0].direction)), 1)
+        else:
+            peak = est = max(len(self.g.get_index(pats[0].subject,
+                                                  pats[0].direction)), 1)
+            bound = {pats[0].object}
+            for pat in pats[1:]:
+                if pat.object < 0 and pat.object not in bound \
+                        and pat.subject in bound:
+                    # a genuine expansion; member/k2k steps only shrink
+                    est = int(est * self._fanout(pat)) or 1
+                    peak = max(peak, est)
+                    bound.add(pat.object)
         B = 1
-        while B < cap and 2 * B * peak <= self.cap_max // 2:
+        while B < cap and 2 * B * peak * self.EST_SAFETY <= self.cap_max:
             B *= 2
         return B
 
@@ -419,11 +484,16 @@ class TPUEngine:
         return max(1.0, host.num_edges / max(len(host.keys), 1)) * 2
 
     # ------------------------------------------------------------------
-    def _estimate_rows(self, state, pat, seg) -> int:
+    def _estimate_rows(self, state, pat, seg, step=None) -> int:
         """Expected output rows of an expansion step.
 
-        Uses the shared _fanout estimate; rounds up to a capacity class. A
-        wrong estimate costs one chain retry, never correctness."""
+        Prefers the planner's joint-type-table per-step estimate
+        (state.step_est) with EST_SAFETY headroom; falls back to the shared
+        _fanout estimate. A wrong estimate costs one chain retry, never
+        correctness."""
+        se = state.step_est.get(step) if step is not None else None
+        if se is not None:
+            return max(min(int(se * self.EST_SAFETY), self.cap_max), 1)
         est = int(min(state.est_rows * self._fanout(pat, seg), self.cap_max))
         return max(est, 1)
 
@@ -487,6 +557,7 @@ class _ChainState:
         self.new_cols: list = []
         self.totals: list = []  # (step, device_total, cap)
         self.est_rows = 1
+        self.step_est: dict = {}  # {step: planner row estimate}
         self.local_var = 0
 
     def col_of(self, var: int):

@@ -277,15 +277,26 @@ def member_mask_known(table, n, vals, bkey, bstart, bdeg, edges,
     return valid & found & ok
 
 
-@jax.jit
 def compact(table, keep):
     """Keep masked rows, packed to the front. table: [W, C] -> ([W, C], n)."""
+    out, n, _total = compact_to(table, keep, table.shape[1])
+    return out, n
+
+
+@partial(jax.jit, static_argnames=("cap_out",))
+def compact_to(table, keep, cap_out):
+    """compact into a SMALLER capacity class (estimate-driven mid-chain
+    shrink: later kernels pay for capacity, not live rows). Returns
+    (out [W, cap_out], n, total) — total is the true surviving count; if it
+    exceeds cap_out the end-of-chain overflow check retries the chain with an
+    exact capacity, so rows are never silently dropped."""
     W, C = table.shape
-    new_n = keep.sum().astype(jnp.int32)
-    idx = jnp.nonzero(keep, size=C, fill_value=C - 1)[0]
+    total = keep.sum().astype(jnp.int32)
+    idx = jnp.nonzero(keep, size=cap_out, fill_value=C - 1)[0]
     out = table[:, idx]
-    live = jnp.arange(C, dtype=jnp.int32) < new_n
-    return jnp.where(live[None, :], out, 0), new_n
+    live = jnp.arange(cap_out, dtype=jnp.int32) < total
+    return jnp.where(live[None, :], out, 0), \
+        jnp.minimum(total, cap_out).astype(jnp.int32), total
 
 
 @partial(jax.jit, static_argnames=("cap",))

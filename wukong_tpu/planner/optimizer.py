@@ -125,7 +125,6 @@ class Planner:
     # start candidates (const start / type index / predicate index)
     # ------------------------------------------------------------------
     def _start_candidates(self, pats: list):
-        st = self.stats
         out = []
         for p in pats:
             if p.predicate < 0:
@@ -141,40 +140,63 @@ class Planner:
                 continue
             if p.predicate == TYPE_ID and p.subject < 0 and is_tpid(p.object):
                 # type-index start: ?X rdf:type T  ->  (T, rdf:type, IN, ?X)
-                dist = {t: float(st.tyscount.get(t, 0))
-                        for t in st.types_containing(p.object)}
                 out.append(self._mk_start(
                     Pattern(p.object, TYPE_ID, IN, p.subject), p,
-                    var=p.subject, dist=dist))
+                    var=p.subject, dist=self._type_index_dist(p.object)))
                 continue
             if p.subject >= NORMAL_ID_START and p.object < 0:
-                deg = self._const_fanout(p.predicate, OUT)
-                # neighbor types of the const's actual type (fine_type keyed
-                # by the anchor type with OUT direction); potype fallback
-                ct = st.type_of(p.subject)
-                dist = dict(st.fine_type.get((ct, p.predicate, OUT), {})) or \
-                    {t: c for t, c in st.potype.get(p.predicate, {}).items()}
                 out.append(self._mk_start(
                     Pattern(p.subject, p.predicate, OUT, p.object,
                             p.pred_type), p,
-                    var=p.object, dist=self._norm(dist, deg)))
+                    var=p.object,
+                    dist=self._const_start_dist(p.subject, p.predicate, OUT)))
             if p.object >= NORMAL_ID_START and p.subject < 0:
-                deg = self._const_fanout(p.predicate, IN)
-                ct = st.type_of(p.object)
-                dist = dict(st.fine_type.get((ct, p.predicate, IN), {})) or \
-                    {t: c for t, c in st.pstype.get(p.predicate, {}).items()}
                 out.append(self._mk_start(
                     Pattern(p.object, p.predicate, IN, p.subject,
                             p.pred_type), p,
-                    var=p.subject, dist=self._norm(dist, deg)))
+                    var=p.subject,
+                    dist=self._const_start_dist(p.object, p.predicate, IN)))
             if p.subject < 0 and p.object < 0 and p.predicate > 1:
                 # predicate-index start (both sides): dummy __PREDICATE__
-                dist = {t: float(c) for t, c in
-                        st.pstype.get(p.predicate, {}).items()}
                 out.append(self._mk_start(
                     Pattern(p.predicate, PREDICATE_ID, IN, p.subject), None,
-                    var=p.subject, dist=dist))
+                    var=p.subject,
+                    dist=self._pred_index_dist(p.predicate, IN, norm=False)))
         return out
+
+    # start-distribution builders shared by _start_candidates (DFS over
+    # parser-form patterns) and estimate_chain (fixed engine-form plans) —
+    # the cardinality model must not drift between the two
+    def _type_index_dist(self, tpid: int) -> dict:
+        st = self.stats
+        return {t: float(st.tyscount.get(t, 0))
+                for t in st.types_containing(tpid)}
+
+    def _pred_index_dist(self, pid: int, d: int, norm: bool = True) -> dict:
+        """Type distribution of a predicate-index scan's bound var. With
+        norm=True the mass is rescaled to the distinct anchor count (the
+        engine's index list length); norm=False keeps raw edge counts (the
+        DFS treats the scan as producing one row per edge endpoint)."""
+        st = self.stats
+        dist = {t: float(c) for t, c in
+                (st.pstype if d == IN else st.potype).get(pid, {}).items()}
+        if not norm:
+            return dist
+        n = float((st.distinct_subj if d == IN
+                   else st.distinct_obj).get(pid, 0)) or 1.0
+        return self._norm(dist, n) if dist else {0: n}
+
+    def _const_start_dist(self, const: int, pid: int, d: int) -> dict:
+        """Neighbor-type distribution of one constant's expansion: the
+        const's actual type via fine_type, falling back to the predicate's
+        endpoint histogram; mass = the const's average fanout."""
+        st = self.stats
+        deg = self._const_fanout(pid, d)
+        ct = st.type_of(const)
+        dist = dict(st.fine_type.get((ct, pid, d), {})) or \
+            {t: c for t, c in
+             (st.potype if d == OUT else st.pstype).get(pid, {}).items()}
+        return self._norm(dist, deg)
 
     def _mk_start(self, pat: Pattern, consumes, var: int, dist):
         dist = {t: c for t, c in (dist or {}).items() if c > 0} or {0: 1.0}
@@ -201,7 +223,11 @@ class Planner:
     # ------------------------------------------------------------------
     # step estimation over the joint type table (planner.hpp:218-874)
     # ------------------------------------------------------------------
-    def _estimate_step(self, state: _State, p: Pattern) -> _State | None:
+    def _estimate_step(self, state: _State, p: Pattern,
+                       pre_oriented: bool = False) -> _State | None:
+        """pre_oriented=True: p is already in engine form (anchor in subject,
+        direction selecting the adjacency side) — estimate_chain's case; the
+        DFS passes parser-form patterns that _orient normalizes."""
         st = self.stats
         s_var_b = p.subject < 0 and p.subject in state.vars
         o_var_b = p.object < 0 and p.object in state.vars
@@ -220,7 +246,7 @@ class Planner:
                           state.plan + [(self._orient(state, p), p)])
         if not (s_var_b or o_var_b):
             return None
-        oriented = self._orient(state, p)
+        oriented = p if pre_oriented else self._orient(state, p)
         d = oriented.direction
         if oriented.subject > 0:
             # const anchor mid-plan: only membership on a bound object is
@@ -231,6 +257,10 @@ class Planner:
             const_t = st.type_of(oriented.subject)
             ia = None
         else:
+            if oriented.subject not in state.vars:
+                # pre-oriented chains can anchor on an unbound subject (e.g.
+                # user plan_text plans); unestimable, per the None contract
+                return None
             const_t = 0
             ia = state.vars.index(oriented.subject)
 
@@ -319,6 +349,47 @@ class Planner:
                       ttab or {(0,) * len(state.vars): rows},
                       state.cost + INIT_COST + state.rows * COST_PROBE,
                       state.plan + [(oriented, p)])
+
+    # ------------------------------------------------------------------
+    def estimate_chain(self, patterns: list) -> list | None:
+        """Per-step output-row estimates for an ALREADY-ORDERED pattern list
+        (the plan the engine will execute).
+
+        Returns [rows_after_step_k for k in range(len(patterns))], or None if
+        the chain shape cannot be walked. This is the joint-type-table model
+        of _estimate_step applied to a fixed order — the engine uses it to
+        size device binding-table capacities tightly instead of compounding
+        per-step fanout safety margins (each 2x over-provision doubles every
+        kernel's cost: kernels pay for capacity, not live rows)."""
+        if not patterns:
+            return None
+        p0 = patterns[0]
+        ests: list[float] = []
+        state = None
+        if p0.predicate == TYPE_ID and is_tpid(p0.subject) and p0.object < 0:
+            # engine-form type-index start: (T, rdf:type, IN, ?X)
+            state = self._mk_start(p0, p0, var=p0.object,
+                                   dist=self._type_index_dist(p0.subject))
+        elif p0.predicate == PREDICATE_ID and p0.object < 0:
+            # predicate-index start: rows = distinct anchors of the predicate
+            state = self._mk_start(
+                p0, p0, var=p0.object,
+                dist=self._pred_index_dist(p0.subject, p0.direction))
+        elif p0.subject >= NORMAL_ID_START and p0.object < 0:
+            state = self._mk_start(
+                p0, p0, var=p0.object,
+                dist=self._const_start_dist(p0.subject, p0.predicate,
+                                            p0.direction))
+        if state is None:
+            return None
+        ests.append(state.rows)
+        for p in patterns[1:]:
+            nxt = self._estimate_step(state, p, pre_oriented=True)
+            if nxt is None:
+                return None
+            state = nxt
+            ests.append(state.rows)
+        return ests
 
     def _orient(self, state: _State, p: Pattern) -> Pattern:
         s_var_b = p.subject < 0 and p.subject in state.vars

@@ -198,9 +198,20 @@ def main(argv=None):
                     help="graph partitions (default: 1, or device count with --dist)")
     ap.add_argument("--dist", action="store_true",
                     help="partition across all visible devices")
+    ap.add_argument("-b", "--bind", default=None, metavar="core.bind",
+                    help="enable thread->core binding from a core.bind file "
+                         "(reference: wukong -b, bind.hpp)")
     args = ap.parse_args(argv)
+    from wukong_tpu.utils.jaxenv import respect_platform_env
+
+    respect_platform_env()
 
     load_config(args.config, num_workers=args.workers)
+    if args.bind is not None:
+        # after load_config: the binding sanity check reads Global.num_engines
+        from wukong_tpu.runtime.bind import get_binder
+
+        get_binder().load_core_binding(args.bind)
     from wukong_tpu.engine.cpu import CPUEngine
     from wukong_tpu.engine.tpu import TPUEngine
     from wukong_tpu.loader.base import load_dataset

@@ -119,6 +119,9 @@ class EnginePool:
         return None
 
     def _run_engine(self, tid: int) -> None:
+        from wukong_tpu.runtime.bind import get_binder
+
+        get_binder().bind_thread(tid)  # no-op unless core binding is enabled
         engine = self._make_engine(tid)
         snooze_us = 10
         while not self._stop.is_set():

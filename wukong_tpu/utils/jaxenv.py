@@ -1,0 +1,26 @@
+"""JAX platform-selection guard for CLI entry points.
+
+The axon sitecustomize registers the TPU PJRT plugin at interpreter start and
+pins jax.config.jax_platforms to "axon,cpu", silently overriding the user's
+JAX_PLATFORMS env var. When the TPU relay is unreachable, initializing the
+axon backend then blocks forever — so a user who explicitly asked for
+JAX_PLATFORMS=cpu would still hang. Entry points call respect_platform_env()
+before any backend initializes to restore the documented env-var contract.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def respect_platform_env() -> None:
+    env = os.environ.get("JAX_PLATFORMS")
+    if not env:
+        return
+    import jax
+
+    try:
+        if jax.config.jax_platforms != env:
+            jax.config.update("jax_platforms", env)
+    except Exception:
+        pass  # unknown platform names surface later with a clear jax error
