@@ -99,13 +99,15 @@ def _ensure_world(scale: int):
     return g, ss, stats
 
 
-def _probe_backend(deadline_s: int = 240) -> bool:
+def _probe_backend(deadline_s: int | None = None) -> bool:
     """Probe the TPU backend in a subprocess (a crashed relay worker hangs
     jax initialization indefinitely). Returns True when the device backend is
     healthy; False means the bench must degrade to the CPU backend — a round
     must never end with no captured number (round-1 verdict Weak #3)."""
     import subprocess
 
+    if deadline_s is None:
+        deadline_s = int(os.environ.get("WUKONG_PROBE_TIMEOUT", "240"))
     try:
         r = subprocess.run(
             [sys.executable, "-c",
@@ -294,6 +296,20 @@ def dbpedia_main(device_ok: bool) -> None:
     }))
 
 
+def _apply_kernel_toggles() -> None:
+    """Env-driven kernel A/B switches — read in EVERY process (the --one
+    measurement subprocesses inherit the env, not the parent's Global)."""
+    from wukong_tpu.config import Global
+
+    if os.environ.get("WUKONG_ENABLE_PALLAS", "1") == "0":
+        Global.enable_pallas = False
+        print("# pallas disabled via WUKONG_ENABLE_PALLAS=0", file=sys.stderr)
+    if os.environ.get("WUKONG_ENABLE_FP_PROBE", "1") == "0":
+        Global.enable_fp_probe = False
+        print("# fp probe disabled via WUKONG_ENABLE_FP_PROBE=0",
+              file=sys.stderr)
+
+
 def _setup_jax_caches() -> None:
     """Persistent XLA compilation cache: the axon-tunneled backend compiles
     slowly (tens of seconds per program), so repeated bench runs must reuse
@@ -309,14 +325,65 @@ def _setup_jax_caches() -> None:
         print(f"# compilation cache unavailable: {e}", file=sys.stderr)
 
 
+def _measure_one(qn: str, scale: int) -> dict:
+    """Measure one LUBM query (3 trials, batched); returns its detail dict.
+    Runs inside the per-query subprocess in the default orchestrated mode."""
+    g, ss, stats = _ensure_world(scale)
+    from wukong_tpu.engine.tpu import TPUEngine
+    from wukong_tpu.planner.heuristic import heuristic_plan
+    from wukong_tpu.sparql.parser import Parser
+
+    eng = TPUEngine(g, ss, stats=stats)
+    text = open(f"{BASIC}/{qn}").read()
+    q0 = Parser(ss).parse(text)
+    heuristic_plan(q0)
+    const_start = q0.pattern_group.patterns[0].subject >= (1 << 17)
+    bq = BATCH if const_start else eng.suggest_index_batch(q0)
+    best = None
+    nrows = -1
+    for _trial in range(3):
+        q = Parser(ss).parse(text)
+        heuristic_plan(q)
+        q.result.blind = True
+        if const_start:
+            consts = np.full(bq, q.pattern_group.patterns[0].subject,
+                             dtype=np.int64)
+            t = time.perf_counter()
+            counts = eng.execute_batch(q, consts)
+        else:
+            t = time.perf_counter()
+            counts = eng.execute_batch_index(q, bq)
+        dt = (time.perf_counter() - t) * 1e6 / bq
+        nrows = int(counts[0])
+        best = dt if best is None else min(best, dt)
+    return {"us": round(best, 1), "rows": nrows, "batch": bq}
+
+
+def _one_query_main() -> None:
+    """`bench.py --one <qn>`: subprocess entry. The orchestrator has already
+    probed the backend (env WUKONG_BENCH_BACKEND) and built the world caches;
+    this process measures one query and prints its JSON detail as the last
+    stdout line. Isolation means a TPU worker crash or a relay hang costs one
+    query, not the whole round (the round-1 failure mode)."""
+    qn = sys.argv[sys.argv.index("--one") + 1]
+    scale = int(os.environ.get("WUKONG_BENCH_SCALE") or 160)
+    device_ok = os.environ.get("WUKONG_BENCH_BACKEND", "tpu") == "tpu"
+    _setup_jax_caches()
+    _apply_kernel_toggles()
+    if not device_ok:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    print(json.dumps(_measure_one(qn, scale)))
+
+
 def main():
+    if "--one" in sys.argv:
+        _one_query_main()
+        return
     device_ok = _probe_backend()
     _setup_jax_caches()
-    if os.environ.get("WUKONG_ENABLE_PALLAS", "1") == "0":
-        from wukong_tpu.config import Global
-
-        Global.enable_pallas = False
-        print("# pallas disabled via WUKONG_ENABLE_PALLAS=0", file=sys.stderr)
+    _apply_kernel_toggles()
     if not device_ok:
         # sitecustomize already registered the axon plugin at startup; the
         # config update (not env vars) is what pins the CPU backend now.
@@ -344,15 +411,23 @@ def main():
               "(single-core host must still capture a number)", file=sys.stderr)
         scale = 40
     t0 = time.time()
-    g, ss, stats = _ensure_world(scale)
+    g, ss, stats = _ensure_world(scale)  # builds the .cache/ artifacts once
     print(f"# world ready in {time.time() - t0:.0f}s "
           f"({g.stats_str()})", file=sys.stderr)
+    del g, ss, stats
 
-    from wukong_tpu.engine.tpu import TPUEngine
-    from wukong_tpu.planner.heuristic import heuristic_plan
-    from wukong_tpu.sparql.parser import Parser
+    # Each query measures in its own subprocess with a hard deadline: a TPU
+    # worker crash ("kernel fault") or an indefinitely-hung relay costs that
+    # one query, and the round still records every other number (round-1
+    # ended with parsed:null; never again). The persistent XLA cache keeps
+    # the per-process compile cost to one cold run.
+    import subprocess
 
-    eng = TPUEngine(g, ss, stats=stats)
+    q_deadline = int(os.environ.get(
+        "WUKONG_QUERY_TIMEOUT", "900" if device_ok else "600"))
+    env = dict(os.environ,
+               WUKONG_BENCH_SCALE=str(scale),
+               WUKONG_BENCH_BACKEND="tpu" if device_ok else "cpu")
     lat_us = []
     ref_us = []  # reference entries for the SAME surviving queries
     details = {}
@@ -360,41 +435,29 @@ def main():
     for i, qn in enumerate([f"lubm_q{k}" for k in range(1, 8)]):
         print(f"# [{time.strftime('%H:%M:%S')}] {qn} starting",
               file=sys.stderr, flush=True)
-        text = open(f"{BASIC}/{qn}").read()
-        q0 = Parser(ss).parse(text)
-        heuristic_plan(q0)
-        const_start = q0.pattern_group.patterns[0].subject >= (1 << 17)
-        # heavies (index-origin) batch as many replicated instances as fit
-        # the capacity ceiling; lights batch BATCH start constants
-        bq = BATCH if const_start else eng.suggest_index_batch(q0)
-        best = None
-        nrows = -1
         try:
-            for trial in range(3):
-                q = Parser(ss).parse(text)
-                heuristic_plan(q)
-                q.result.blind = True
-                if const_start:
-                    consts = np.full(bq, q.pattern_group.patterns[0].subject,
-                                     dtype=np.int64)
-                    t = time.perf_counter()
-                    counts = eng.execute_batch(q, consts)
-                else:
-                    t = time.perf_counter()
-                    counts = eng.execute_batch_index(q, bq)
-                dt = (time.perf_counter() - t) * 1e6 / bq
-                nrows = int(counts[0])
-                best = dt if best is None else min(best, dt)
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--one", qn],
+                env=env, timeout=q_deadline, capture_output=True)
+            if r.returncode != 0:
+                raise RuntimeError(
+                    f"rc={r.returncode}: {r.stderr.decode()[-300:]}")
+            d = json.loads(r.stdout.decode().strip().splitlines()[-1])
+        except subprocess.TimeoutExpired:
+            failed.append(qn)
+            details[qn] = {"error": f"timeout after {q_deadline}s"}
+            print(f"# {qn}: TIMEOUT ({q_deadline}s)", file=sys.stderr)
+            continue
         except Exception as e:  # one bad query must not zero the whole bench
             failed.append(qn)
-            details[qn] = {"error": str(e)[:200]}
+            details[qn] = {"error": str(e)[:300]}
             print(f"# {qn}: FAILED ({e})", file=sys.stderr)
             continue
-        lat_us.append(best)
+        lat_us.append(d["us"])
         ref_us.append(REF_GPU_LUBM2560[i])
-        details[qn] = {"us": round(best, 1), "rows": nrows, "batch": bq}
-        print(f"# {qn}: {best:,.0f} us (rows={nrows}, batch={bq})",
-              file=sys.stderr)
+        details[qn] = d
+        print(f"# {qn}: {d['us']:,.0f} us (rows={d['rows']}, "
+              f"batch={d['batch']})", file=sys.stderr)
     if not lat_us:
         raise SystemExit("all bench queries failed")
 

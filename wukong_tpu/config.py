@@ -44,6 +44,7 @@ class GlobalConfig:
     enable_budget: bool = True
     gpu_enable_pipeline: bool = True  # prefetch next pattern's segments to HBM
     enable_pallas: bool = True  # Pallas probe kernel on TPU backends
+    enable_fp_probe: bool = True  # fingerprint-packed hash probe (XLA path)
 
     # ---- TPU-engine knobs (new; no reference analogue) ----
     table_capacity_min: int = 1024  # smallest binding-table capacity class

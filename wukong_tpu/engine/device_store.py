@@ -47,14 +47,48 @@ class DeviceSegment:
     num_edges: int
     max_probe: int  # static probe-round bound — part of the jit key
     max_deg_log2: int  # static binary-search depth for membership tests
+    fpw0: object = None  # jnp int32 [NB] packed lane-0..3 fingerprints
+    fpw1: object = None  # jnp int32 [NB] packed lane-4..7 fingerprints
+    max_fp_dup: int = 1  # exact max same-fp count within any bucket (static)
 
     @property
     def nbytes(self) -> int:
-        return (self.bkey.size + self.bstart.size
-                + self.bdeg.size + self.edges.size) * 4
+        n = (self.bkey.size + self.bstart.size
+             + self.bdeg.size + self.edges.size) * 4
+        if self.fpw0 is not None:
+            n += (self.fpw0.size + self.fpw1.size) * 4
+        return n
 
 
 _HASH_MULT = np.uint32(2654435761)  # Knuth multiplicative hashing
+_FP_MULT = np.uint32(0x9E3779B1)  # fingerprint hash (tpu_kernels._fp_of)
+
+
+def fp_words(bkey_2d: np.ndarray):
+    """Pack per-slot 8-bit key fingerprints into two int32 words per bucket.
+
+    Returns (fpw0 [NB], fpw1 [NB], max_fp_dup). Fingerprints are 1..255 (0 =
+    empty slot); max_fp_dup is the EXACT max count of identical fingerprints
+    within any single bucket — the static number of candidate verifications
+    the fp probe needs for zero false negatives (tpu_kernels._hash_find_fp).
+    """
+    fp = ((bkey_2d.astype(np.int64).astype(np.uint32) * _FP_MULT) >> 24) \
+        & np.uint32(0xFF)
+    fp = np.where(fp == 0, 1, fp).astype(np.uint32)
+    fp = np.where(bkey_2d < 0, np.uint32(0), fp)
+    w0 = fp[:, 0] | (fp[:, 1] << 8) | (fp[:, 2] << 16) | (fp[:, 3] << 24)
+    w1 = fp[:, 4] | (fp[:, 5] << 8) | (fp[:, 6] << 16) | (fp[:, 7] << 24)
+    srt = np.sort(fp, axis=1)
+    same = (srt[:, 1:] == srt[:, :-1]) & (srt[:, 1:] != 0)
+    dup = 1
+    if same.any():
+        cur = np.ones(fp.shape[0], dtype=np.int64)
+        maxr = np.ones(fp.shape[0], dtype=np.int64)
+        for j in range(same.shape[1]):
+            cur = np.where(same[:, j], cur + 1, 1)
+            maxr = np.maximum(maxr, cur)
+        dup = int(maxr.max())
+    return w0.view(np.int32), w1.view(np.int32), dup
 
 
 def type_index_csr(g):
@@ -214,6 +248,7 @@ class DeviceStore:
         bkey, bstart, bdeg, max_probe = build_hash_table(
             np.asarray(keys), np.asarray(offsets))
         max_deg = int((offsets[1:] - offsets[:-1]).max()) if K else 1
+        w0, w1, fp_dup = fp_words(bkey)
         seg = DeviceSegment(
             bkey=jax.device_put(jnp.asarray(bkey.reshape(-1)), self.device),
             bstart=jax.device_put(jnp.asarray(bstart.reshape(-1)), self.device),
@@ -221,6 +256,9 @@ class DeviceStore:
             edges=jax.device_put(jnp.asarray(e), self.device),
             num_keys=K, num_edges=E, max_probe=max_probe,
             max_deg_log2=max(int(max_deg).bit_length(), 1),
+            fpw0=jax.device_put(jnp.asarray(w0), self.device),
+            fpw1=jax.device_put(jnp.asarray(w1), self.device),
+            max_fp_dup=fp_dup,
         )
         return seg
 

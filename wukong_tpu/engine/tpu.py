@@ -254,11 +254,14 @@ class TPUEngine:
             est = self._estimate_rows(state, pat, seg, step=step)
             cap_out = cap_override.get(step) or K.next_capacity(
                 max(est, self.cap_min), self.cap_min, self.cap_max)
+            up = K.want_pallas(seg.bkey, state.table.shape[1])
+            fd = self._fp_dup(seg, up)
             out, nn, total = K.expand(
                 state.table, state.n, seg.bkey, seg.bstart, seg.bdeg,
                 seg.edges, col=col, cap_out=cap_out,
-                max_probe=seg.max_probe,
-                use_pallas=K.want_pallas(seg.bkey, state.table.shape[1]))
+                max_probe=seg.max_probe, use_pallas=up,
+                fpw0=seg.fpw0 if fd else None,
+                fpw1=seg.fpw1 if fd else None, fp_dup=fd)
             state.advance_expand(out, nn, end, total, cap_out, step,
                                  est_rows=min(est, cap_out))
         else:  # known_to_known / known_to_const
@@ -269,11 +272,14 @@ class TPUEngine:
                     vals = state.table[e_col]
                 else:
                     vals = jnp.full(state.table.shape[1], np.int32(end))
+                up = K.want_pallas(seg.bkey, state.table.shape[1])
+                fd = self._fp_dup(seg, up)
                 keep = K.member_mask_known(
                     state.table, state.n, vals, seg.bkey, seg.bstart,
                     seg.bdeg, seg.edges, col=col, max_probe=seg.max_probe,
-                    depth=seg.max_deg_log2,
-                    use_pallas=K.want_pallas(seg.bkey, state.table.shape[1]))
+                    depth=seg.max_deg_log2, use_pallas=up,
+                    fpw0=seg.fpw0 if fd else None,
+                    fpw1=seg.fpw1 if fd else None, fp_dup=fd)
             C = state.table.shape[1]
             se = state.step_est.get(step)
             cap_new = cap_override.get(step)
@@ -496,6 +502,18 @@ class TPUEngine:
             return max(min(int(se * self.EST_SAFETY), self.cap_max), 1)
         est = int(min(state.est_rows * self._fanout(pat, seg), self.cap_max))
         return max(est, 1)
+
+    @staticmethod
+    def _fp_dup(seg, use_pallas: bool = False) -> int:
+        """Static fp-probe selector for this segment, or 0 (= classic/Pallas
+        probe). max_fp_dup is data-derived, so it is quantized to {2, 4, 8}
+        to bound jit-cache fragmentation — rounding UP is safe (extra
+        verification candidates, never a false negative)."""
+        if use_pallas or seg.fpw0 is None \
+                or not getattr(Global, "enable_fp_probe", True):
+            return 0
+        d = seg.max_fp_dup
+        return 2 if d <= 2 else (4 if d <= 4 else 8)
 
     # ------------------------------------------------------------------
     def _device_supported(self, q: SPARQLQuery, pat, probe, is_first: bool) -> bool:

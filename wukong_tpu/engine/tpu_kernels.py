@@ -192,6 +192,67 @@ def _hash_find(bkey, bstart, bdeg, cur, valid, max_probe: int):
     return ok, jnp.where(ok, start, 0), jnp.where(ok, deg, 0)
 
 
+_FP_MULT = np.uint32(0x9E3779B1)
+
+
+def _fp_of(cur):
+    """8-bit key fingerprint, 1..255 (0 marks an empty slot)."""
+    fp = ((cur.astype(jnp.uint32) * _FP_MULT) >> np.uint32(24)) \
+        & np.uint32(0xFF)
+    return jnp.where(fp == 0, np.uint32(1), fp)
+
+
+def _hash_find_fp(bkey, bstart, bdeg, fpw0, fpw1, cur, valid,
+                  max_probe: int, fp_dup: int):
+    """Fingerprint-packed probe: same contract as _hash_find with ~5 [C]
+    gathers per round instead of 24.
+
+    fpw0/fpw1 pack the bucket's 8 slot fingerprints into two int32 words
+    (staging computes them host-side). A probe round gathers the two words,
+    compares all 8 fingerprints in-registers, then verifies only the
+    candidate lanes against bkey. fp_dup (static, from staging) is the exact
+    max count of identical fingerprints within any one bucket — the number of
+    candidate verifications that guarantees no false negative. Random fused
+    gathers cost ~30 ns/elem on v5e, so gathered volume IS the probe cost.
+    """
+    NB = fpw0.shape[0]
+    bmask = np.uint32(NB - 1)
+    C = cur.shape[0]
+    curfp = _fp_of(cur)
+    hb = (cur.astype(jnp.uint32) * _HASH_MULT) & bmask
+    found = jnp.zeros(C, bool)
+    start = jnp.zeros_like(cur)
+    deg = jnp.zeros_like(cur)
+    for r in range(max_probe):
+        b = ((hb + np.uint32(r)) & bmask).astype(jnp.int32)
+        w0 = fpw0[b].astype(jnp.uint32)
+        w1 = fpw1[b].astype(jnp.uint32)
+        run = jnp.zeros(C, jnp.int32)
+        lane_sel = [jnp.full(C, -1, jnp.int32) for _ in range(fp_dup)]
+        for lane in range(BUCKET):
+            w = w0 if lane < 4 else w1
+            fpl = (w >> np.uint32(8 * (lane & 3))) & np.uint32(0xFF)
+            is_m = fpl == curfp
+            for v in range(fp_dup):
+                lane_sel[v] = jnp.where(is_m & (run == v), lane, lane_sel[v])
+            run = run + is_m.astype(jnp.int32)
+        hit_any = jnp.zeros(C, bool)
+        idx_win = jnp.zeros(C, jnp.int32)
+        for v in range(fp_dup):
+            has = lane_sel[v] >= 0
+            idx = b * BUCKET + jnp.maximum(lane_sel[v], 0)
+            kk = bkey[idx]
+            hit = has & (kk == cur)
+            idx_win = jnp.where(hit & ~hit_any, idx, idx_win)
+            hit_any = hit_any | hit
+        news = hit_any & (~found)
+        start = jnp.where(news, bstart[idx_win], start)
+        deg = jnp.where(news, bdeg[idx_win], deg)
+        found = found | hit_any
+    ok = valid & found
+    return ok, jnp.where(ok, start, 0), jnp.where(ok, deg, 0)
+
+
 def _range_member(edges, lo, hi, vals, depth: int):
     """Is vals[i] in sorted edges[lo[i]:hi[i]]? Binary search, static depth."""
     E = edges.shape[0]
@@ -216,20 +277,26 @@ def _range_member(edges, lo, hi, vals, depth: int):
 # ---------------------------------------------------------------------------
 
 
-def _probe(bkey, bstart, bdeg, cur, n, max_probe: int, use_pallas: bool):
-    """Probe dispatch. `use_pallas` is the caller's STATIC decision (see
-    want_pallas); row validity is derived from `n` on both paths so the two
-    backends can never diverge on masking."""
+def _probe(bkey, bstart, bdeg, cur, n, max_probe: int, use_pallas: bool,
+           fpw0=None, fpw1=None, fp_dup: int = 0):
+    """Probe dispatch. `use_pallas` and `fp_dup` are the caller's STATIC
+    decisions (see want_pallas / DeviceSegment.max_fp_dup); row validity is
+    derived from `n` on every path so the backends can never diverge on
+    masking. fp_dup > 0 selects the fingerprint-packed probe."""
     if use_pallas:
         return pallas_probe(bkey, bstart, bdeg, cur, n, max_probe)
     valid = jnp.arange(cur.shape[0], dtype=jnp.int32) < n
+    if fp_dup > 0 and fpw0 is not None:
+        return _hash_find_fp(bkey, bstart, bdeg, fpw0, fpw1, cur, valid,
+                             max_probe, fp_dup)
     return _hash_find(bkey, bstart, bdeg, cur, valid, max_probe)
 
 
 @partial(jax.jit,
-         static_argnames=("col", "cap_out", "max_probe", "use_pallas"))
+         static_argnames=("col", "cap_out", "max_probe", "use_pallas",
+                          "fp_dup"))
 def expand(table, n, bkey, bstart, bdeg, edges, col, cap_out, max_probe,
-           use_pallas=False):
+           use_pallas=False, fpw0=None, fpw1=None, fp_dup=0):
     """known_to_unknown: expand each live row by its neighbor list.
 
     table: [W, C]. Returns (out [W+1, cap_out], out_n, total) — total may
@@ -241,7 +308,7 @@ def expand(table, n, bkey, bstart, bdeg, edges, col, cap_out, max_probe,
     valid = rows < n
     cur = table[col]
     found, start, deg = _probe(bkey, bstart, bdeg, cur, n, max_probe,
-                               use_pallas)
+                               use_pallas, fpw0, fpw1, fp_dup)
     cum = jnp.cumsum(deg)
     total = cum[C - 1]
     starts_excl = cum - deg
@@ -262,9 +329,11 @@ def expand(table, n, bkey, bstart, bdeg, edges, col, cap_out, max_probe,
 
 
 @partial(jax.jit,
-         static_argnames=("col", "max_probe", "depth", "use_pallas"))
+         static_argnames=("col", "max_probe", "depth", "use_pallas",
+                          "fp_dup"))
 def member_mask_known(table, n, vals, bkey, bstart, bdeg, edges,
-                      col, max_probe, depth, use_pallas=False):
+                      col, max_probe, depth, use_pallas=False,
+                      fpw0=None, fpw1=None, fp_dup=0):
     """known_to_known / known_to_const: per-row membership of vals[i] in
     adj(cur[i]). table: [W, C]; vals: [C]."""
     W, C = table.shape
@@ -272,21 +341,14 @@ def member_mask_known(table, n, vals, bkey, bstart, bdeg, edges,
     valid = rows < n
     cur = table[col]
     found, start, deg = _probe(bkey, bstart, bdeg, cur, n, max_probe,
-                               use_pallas)
+                               use_pallas, fpw0, fpw1, fp_dup)
     ok = _range_member(edges, start, start + deg, vals, depth)
     return valid & found & ok
 
 
-def compact(table, keep):
-    """Keep masked rows, packed to the front. table: [W, C] -> ([W, C], n)."""
-    out, n, _total = compact_to(table, keep, table.shape[1])
-    return out, n
-
-
-@partial(jax.jit, static_argnames=("cap_out",))
-def compact_to(table, keep, cap_out):
-    """compact into a SMALLER capacity class (estimate-driven mid-chain
-    shrink: later kernels pay for capacity, not live rows). Returns
+def _compact_to_impl(table, keep, cap_out):
+    """compact into a (possibly smaller) capacity class (estimate-driven
+    mid-chain shrink: later kernels pay for capacity, not live rows). Returns
     (out [W, cap_out], n, total) — total is the true surviving count; if it
     exceeds cap_out the end-of-chain overflow check retries the chain with an
     exact capacity, so rows are never silently dropped."""
@@ -297,6 +359,17 @@ def compact_to(table, keep, cap_out):
     live = jnp.arange(cap_out, dtype=jnp.int32) < total
     return jnp.where(live[None, :], out, 0), \
         jnp.minimum(total, cap_out).astype(jnp.int32), total
+
+
+def _compact_impl(table, keep):
+    out, n, _total = _compact_to_impl(table, keep, table.shape[1])
+    return out, n
+
+
+compact_to = partial(jax.jit, static_argnames=("cap_out",))(_compact_to_impl)
+# jit exposes __wrapped__ = _compact_impl (the dist engine composes the
+# unjitted bodies inside one shard_map program)
+compact = jax.jit(_compact_impl)
 
 
 @partial(jax.jit, static_argnames=("cap",))
