@@ -1,0 +1,129 @@
+"""HDFS dataset source (loader/hdfs.py — the hdfs_loader.hpp analogue).
+
+A fake `hdfs` CLI on PATH serves files out of a local directory, so the test
+exercises the real subprocess plumbing (ls -C listing, -get staging, warm
+cache, gating errors) without a Hadoop install — the fake-cluster philosophy
+of tests/conftest.py applied to the storage layer.
+"""
+
+import os
+import stat
+
+import numpy as np
+import pytest
+
+from wukong_tpu.loader import hdfs
+from wukong_tpu.utils.errors import WukongError
+
+FAKE_HDFS = r"""#!/bin/sh
+# fake `hdfs dfs` CLI: maps hdfs://fake/<path> onto $FAKE_HDFS_ROOT/<path>
+[ "$1" = "dfs" ] || exit 2
+shift
+case "$1" in
+  -ls)
+    [ "$2" = "-C" ] || exit 2
+    dir="${3#hdfs://fake}"
+    for f in "$FAKE_HDFS_ROOT$dir"/*; do
+      [ -e "$f" ] && echo "hdfs://fake$dir/$(basename "$f")"
+    done
+    ;;
+  -get)
+    src="${2#hdfs://fake}"
+    cp "$FAKE_HDFS_ROOT$src" "$3"
+    ;;
+  *) exit 2 ;;
+esac
+"""
+
+
+@pytest.fixture
+def fake_hdfs(tmp_path, monkeypatch):
+    """Install the fake CLI and a remote root; reset the probe cache."""
+    bindir = tmp_path / "bin"
+    bindir.mkdir()
+    exe = bindir / "hdfs"
+    exe.write_text(FAKE_HDFS)
+    exe.chmod(exe.stat().st_mode | stat.S_IEXEC)
+    root = tmp_path / "remote"
+    (root / "data").mkdir(parents=True)
+    monkeypatch.setenv("PATH", f"{bindir}:{os.environ['PATH']}")
+    monkeypatch.setenv("FAKE_HDFS_ROOT", str(root))
+    monkeypatch.delenv("WUKONG_HDFS_CMD", raising=False)
+    old = dict(hdfs._state)
+    hdfs._state.update(cmd=None, probed=False)
+    yield root / "data"
+    hdfs._state.update(old)
+
+
+def _write_dataset(d, triples):
+    np.save(str(d / "id_triples.npy"), np.asarray(triples, dtype=np.int64))
+    (d / "str_index").write_text("<p1>\t131073\n")
+    (d / "ignored.log").write_text("not a dataset file\n")
+
+
+def test_gated_when_no_client(monkeypatch, tmp_path):
+    monkeypatch.setenv("PATH", str(tmp_path))  # nothing on PATH
+    monkeypatch.delenv("WUKONG_HDFS_CMD", raising=False)
+    old = dict(hdfs._state)
+    hdfs._state.update(cmd=None, probed=False)
+    try:
+        assert not hdfs.hdfs_available()
+        with pytest.raises(WukongError):
+            hdfs.list_dir("hdfs://fake/data")
+    finally:
+        hdfs._state.update(old)
+
+
+def test_fetch_and_load_roundtrip(fake_hdfs, tmp_path):
+    tri = [[200000, 131073, 200001], [200001, 131073, 200002]]
+    _write_dataset(fake_hdfs, tri)
+    staged = hdfs.fetch_dataset("hdfs://fake/data", str(tmp_path / "stage"))
+    assert sorted(os.listdir(staged)) == ["id_triples.npy", "str_index"]
+
+    from wukong_tpu.loader.base import load_triples
+
+    got = load_triples(staged)
+    assert got.tolist() == tri
+
+    # warm cache: corrupt the remote file; a re-fetch must NOT re-download
+    np.save(str(fake_hdfs / "id_triples.npy"), np.zeros((1, 3), np.int64))
+    hdfs.fetch_dataset("hdfs://fake/data", str(tmp_path / "stage"))
+    assert load_triples(staged).tolist() == tri
+
+
+def test_resolve_passthrough_and_scheme(fake_hdfs, tmp_path):
+    assert hdfs.resolve_dataset_dir("/local/path") == "/local/path"
+    _write_dataset(fake_hdfs, [[200000, 131073, 200001]])
+    staged = hdfs.resolve_dataset_dir("hdfs://fake/data")
+    assert os.path.exists(os.path.join(staged, "id_triples.npy"))
+    # distinct URIs never share a staging dir (hash tag, not lossy munging)
+    (fake_hdfs.parent / "data_b").mkdir()
+    _write_dataset(fake_hdfs.parent / "data_b", [[200007, 131073, 200008]])
+    staged_b = hdfs.resolve_dataset_dir("hdfs://fake/data_b")
+    assert staged_b != staged
+
+    from wukong_tpu.loader.base import load_triples
+
+    assert load_triples(staged_b).tolist() == [[200007, 131073, 200008]]
+
+
+def test_empty_remote_dir_raises(fake_hdfs):
+    (fake_hdfs / "readme.log").write_text("nothing useful\n")
+    with pytest.raises(WukongError):
+        hdfs.fetch_dataset("hdfs://fake/data")
+
+
+def test_console_accepts_hdfs_uri(fake_hdfs, tmp_path):
+    """End-to-end: console one-shot over an hdfs:// dataset URI."""
+    from wukong_tpu.loader.lubm import write_dataset
+    from wukong_tpu.runtime.console import main as console_main
+
+    local = tmp_path / "lubm1"
+    write_dataset(str(local), 1, seed=0)
+    for name in os.listdir(local):
+        (fake_hdfs / name).write_bytes((local / name).read_bytes())
+
+    cfg = tmp_path / "config"
+    cfg.write_text("global_enable_tpu 0\n")
+    assert console_main([str(cfg), "hdfs://fake/data",
+                         "-c", "store-stat"]) == 0

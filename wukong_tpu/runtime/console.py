@@ -223,6 +223,9 @@ def main(argv=None):
     from wukong_tpu.loader.base import load_attr_triples, load_triples
     from wukong_tpu.store.gstore import build_partition
 
+    from wukong_tpu.loader.hdfs import resolve_dataset_dir
+
+    args.dataset = resolve_dataset_dir(args.dataset)  # hdfs:// -> staged dir
     ss = StringServer(args.dataset)
     # one read of the triple files serves the partitions, the host fallback
     # store, and stats generation

@@ -166,8 +166,10 @@ class Proxy:
         dedup-on-insert option. Inserts reach the host store AND every
         distributed shard (their version bump restages device caches).
         """
+        from wukong_tpu.loader.hdfs import resolve_dataset_dir
         from wukong_tpu.store.dynamic import load_dir_into
 
+        dirname = resolve_dataset_dir(dirname)  # hdfs:// paths stage locally
         targets = [self.g]
         if self.dist is not None:
             targets += [g for g in self.dist.sstore.stores if g is not self.g]

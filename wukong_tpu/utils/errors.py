@@ -23,6 +23,7 @@ class ErrorCode(enum.IntEnum):
     FIRST_PATTERN_ERROR = 10  # start pattern must begin an empty table
     UNKNOWN_PLAN = 11
     UNSUPPORTED_SHAPE = 12  # engine cannot run this plan shape (fallback-able)
+    FILE_NOT_FOUND = 13  # dataset/HDFS source unreachable
 
 
 _MESSAGES = {
@@ -39,6 +40,7 @@ _MESSAGES = {
     ErrorCode.FIRST_PATTERN_ERROR: "start pattern applied to a non-empty table",
     ErrorCode.UNKNOWN_PLAN: "invalid or missing query plan",
     ErrorCode.UNSUPPORTED_SHAPE: "plan shape unsupported by this engine",
+    ErrorCode.FILE_NOT_FOUND: "dataset source unreachable",
 }
 
 
