@@ -25,6 +25,7 @@ class GlobalConfig:
     memstore_size_gb: int = 4
     est_bdr_threshold: int = 0  # reserved (reference RDMA buffer sizing)
     enable_tpu: bool = True  # accelerator engine on (reference: USE_GPU path)
+    enable_merge_join: bool = True  # sort-merge batch chains (gather-free v2)
     # HBM segment-cache budget (reference: gpu_kvcache). Conservative default:
     # heavy-chain buffers at 32M-row capacity classes can hold several GiB
     # live while dispatches pipeline, and a worker OOM crash takes the whole

@@ -15,7 +15,7 @@ from dataclasses import dataclass
 
 import numpy as np
 
-from wukong_tpu.types import IN, TYPE_ID
+from wukong_tpu.types import IN, OUT, PREDICATE_ID, TYPE_ID
 
 INT32_MAX = np.iinfo(np.int32).max
 
@@ -161,6 +161,27 @@ def build_hash_table(keys: np.ndarray, offsets: np.ndarray,
     return bkey, bstart, bdeg, max(round_, 1)
 
 
+@dataclass
+class MergeSegment:
+    """One (pid, dir) CSR segment staged for the sort-merge kernels: sorted
+    key/start/deg arrays (padded with INT32_MAX / 0) plus the per-edge
+    lex-sorted (key, neighbor) pairs for pair-membership joins. The merge
+    path needs sorted order, not buckets — this is the gather-free twin of
+    DeviceSegment (see tpu_kernels.py sort-merge rationale)."""
+
+    skey: object  # jnp int32 [K_pad] sorted keys, pad INT32_MAX
+    sstart: object  # jnp int32 [K_pad] edge range starts, pad 0
+    sdeg: object  # jnp int32 [K_pad] edge range lengths, pad 0
+    edges: object  # jnp int32 [E_pad]
+    ekey: object  # jnp int32 [E_pad] per-edge key (repeat of skey by degree)
+    num_keys: int
+    num_edges: int
+
+    @property
+    def nbytes(self) -> int:
+        return (self.skey.size * 3 + self.edges.size + self.ekey.size) * 4
+
+
 class DeviceStore:
     """Stages host CSR segments into device memory on demand."""
 
@@ -216,9 +237,13 @@ class DeviceStore:
         if key in self._index_cache:
             self._touch(key)
             return self._index_cache[key]
+        arr = np.asarray(self.g.get_index(tpid, d), dtype=np.int32)
+        return self._stage_list(key, arr)
+
+    def _stage_list(self, key, arr: np.ndarray):
+        """Pad + device_put a host list and account it in the LRU/budget."""
         import jax.numpy as jnp
 
-        arr = np.asarray(self.g.get_index(tpid, d), dtype=np.int32)
         pad = _next_pow2(len(arr))
         padded = np.full(pad, INT32_MAX, dtype=np.int32)
         padded[: len(arr)] = arr
@@ -229,6 +254,73 @@ class DeviceStore:
         self.bytes_used += dev.size * 4
         self._enforce_budget()
         return entry
+
+    def merge_segment(self, pid: int, d: int) -> MergeSegment | None:
+        """Stage (pid, dir) for the sort-merge kernels (sorted arrays +
+        per-edge key pairs); TYPE_ID IN resolves to the type index CSR."""
+        self._check_version()
+        key = ("mrg", int(pid), int(d))
+        if key in self._cache:
+            self._touch(key)
+            return self._cache[key]
+        if pid == TYPE_ID and int(d) == IN:
+            keys, offsets, edges = type_index_csr(self.g)
+            if len(keys) == 0:
+                return None
+        else:
+            host = self.g.segments.get((int(pid), int(d)))
+            if host is None:
+                return None
+            keys, offsets, edges = host.keys, host.offsets, host.edges
+        seg = self._stage_merge(keys, offsets, edges)
+        self._insert(key, seg)
+        return seg
+
+    def _stage_merge(self, keys, offsets, edges) -> MergeSegment:
+        import jax
+        import jax.numpy as jnp
+
+        K, E = len(keys), len(edges)
+        Kp, Ep = _next_pow2(K), _next_pow2(E)
+        sk = np.full(Kp, INT32_MAX, dtype=np.int32)
+        sk[:K] = keys
+        ss = np.zeros(Kp, dtype=np.int32)
+        ss[:K] = offsets[:-1]
+        sd = np.zeros(Kp, dtype=np.int32)
+        sd[:K] = offsets[1:] - offsets[:-1]
+        e = np.full(Ep, INT32_MAX, dtype=np.int32)
+        e[:E] = edges
+        ek = np.full(Ep, INT32_MAX, dtype=np.int32)
+        ek[:E] = np.repeat(np.asarray(keys, dtype=np.int32),
+                           (offsets[1:] - offsets[:-1]).astype(np.int64))
+        dev = lambda a: jax.device_put(jnp.asarray(a), self.device)
+        return MergeSegment(skey=dev(sk), sstart=dev(ss), sdeg=dev(sd),
+                            edges=dev(e), ekey=dev(ek),
+                            num_keys=K, num_edges=E)
+
+    def const_list(self, pid: int, d: int, const: int):
+        """Sorted set { x : const ∈ adj(x, pid, d) } staged on device — the
+        k2c merge relation, matching the CPU oracle's _contains_many routing
+        (type membership lives in the index, not a (TYPE_ID, IN) segment).
+        Returns (device array, real_len)."""
+        self._check_version()
+        key = ("rev", int(pid), int(d), int(const))
+        if key in self._index_cache:
+            self._touch(key)
+            return self._index_cache[key]
+        pid, d, const = int(pid), int(d), int(const)
+        if pid == TYPE_ID and d == OUT:
+            host = self.g.get_index(const, IN)  # members of type `const`
+        elif pid == TYPE_ID and d == IN:
+            host = self.g.get_triples(const, TYPE_ID, OUT)  # types of `const`
+        elif pid == PREDICATE_ID:
+            # versatile: vertices with predicate `const` on the d side —
+            # index[(p, OUT)] holds p's objects, so the lookup flips d
+            host = self.g.get_index(const, IN if d == OUT else OUT)
+        else:
+            host = self.g.get_triples(const, pid, IN if d == OUT else OUT)
+        return self._stage_list(key, np.sort(np.asarray(host,
+                                                        dtype=np.int32)))
 
     def _build_type_index_csr(self) -> DeviceSegment | None:
         """Type membership as one CSR keyed by type id (subject-side tidx)."""
@@ -292,12 +384,18 @@ class DeviceStore:
             self._lru.remove(key)
             self._lru.append(key)
 
+    @staticmethod
+    def _pin_key(k):
+        # (pid, d) pins the bucketized staging; ("mrg", pid, d) and
+        # ("rev", pid, d, c) pin merge/const-list stagings as-is
+        return k if isinstance(k[0], str) else (int(k[0]), int(k[1]))
+
     def pin(self, keys) -> None:
-        self._pinned.update((int(p), int(d)) for (p, d) in keys)
+        self._pinned.update(self._pin_key(k) for k in keys)
 
     def unpin(self, keys) -> None:
         for k in keys:
-            self._pinned.discard((int(k[0]), int(k[1])))
+            self._pinned.discard(self._pin_key(k))
         self._enforce_budget()  # pins may have deferred evictions
 
     def prefetch(self, patterns) -> None:

@@ -56,6 +56,9 @@ class TPUEngine:
         self.cap_max = Global.table_capacity_max
         self._est_planner = None  # lazy Planner over self.stats
         self._est_cache: dict = {}  # pattern-tuple -> {step: rows}
+        from wukong_tpu.engine.tpu_merge import MergeExecutor
+
+        self.merge = MergeExecutor(self)  # sort-merge batch chains (v2)
 
     # estimate safety factor: one capacity class of headroom. Kernels pay for
     # CAPACITY, not live rows (a 2x over-provision doubles every gather), so
@@ -330,6 +333,8 @@ class TPUEngine:
                           "batch steps must anchor on a bound column")
             probe.bind(pat)
         B = len(consts)
+        if Global.enable_merge_join and self.merge.supports(q):
+            return self.merge.run_batch_const(q, consts)
 
         def make_init(state: "_ChainState", cap_override: dict) -> int:
             # init: [2, cap] — row 0 qid, row 1 the per-instance start constant
@@ -377,6 +382,8 @@ class TPUEngine:
                           ErrorCode.UNKNOWN_PATTERN,
                           "batch steps must anchor on a bound column")
                 probe.bind(pat)
+        if Global.enable_merge_join and self.merge.supports(q):
+            return self.merge.run_batch_index(q, B, slice_mode)
         edges, real = self.dstore.index_list(pats[0].subject, pats[0].direction)
         total0 = real if slice_mode else real * B
         assert_ec(total0 <= self.cap_max, ErrorCode.UNKNOWN_PATTERN,

@@ -95,6 +95,12 @@ def fetch_dataset(hdfs_dir: str, local_dir: str | None = None) -> str:
         root = os.path.join(tempfile.gettempdir(),
                             f"wukong_hdfs_{getpass.getuser()}")
         os.makedirs(root, mode=0o700, exist_ok=True)
+        st = os.stat(root)  # refuse a pre-planted root (0700 only applies
+        if st.st_uid != os.getuid() or (st.st_mode & 0o077):  # on creation)
+            raise WukongError(
+                ErrorCode.FILE_NOT_FOUND,
+                f"staging root {root} is not owned by this user with mode "
+                "0700 — remove it or pass an explicit local_dir")
         local_dir = os.path.join(root, tag)
     os.makedirs(local_dir, exist_ok=True)
     fetched = have = 0
