@@ -255,6 +255,17 @@ class DeviceStore:
         self._enforce_budget()
         return entry
 
+    def _host_csr(self, pid: int, d: int):
+        """(keys, offsets, edges) of a (pid, dir) host CSR, or None;
+        TYPE_ID IN resolves to the type index CSR."""
+        if int(pid) == TYPE_ID and int(d) == IN:
+            keys, offsets, edges = type_index_csr(self.g)
+            return (keys, offsets, edges) if len(keys) else None
+        host = self.g.segments.get((int(pid), int(d)))
+        if host is None:
+            return None
+        return host.keys, host.offsets, host.edges
+
     def merge_segment(self, pid: int, d: int) -> MergeSegment | None:
         """Stage (pid, dir) for the sort-merge kernels (sorted arrays +
         per-edge key pairs); TYPE_ID IN resolves to the type index CSR."""
@@ -263,16 +274,10 @@ class DeviceStore:
         if key in self._cache:
             self._touch(key)
             return self._cache[key]
-        if pid == TYPE_ID and int(d) == IN:
-            keys, offsets, edges = type_index_csr(self.g)
-            if len(keys) == 0:
-                return None
-        else:
-            host = self.g.segments.get((int(pid), int(d)))
-            if host is None:
-                return None
-            keys, offsets, edges = host.keys, host.offsets, host.edges
-        seg = self._stage_merge(keys, offsets, edges)
+        csr = self._host_csr(pid, d)
+        if csr is None:
+            return None
+        seg = self._stage_merge(*csr)
         self._insert(key, seg)
         return seg
 
@@ -298,6 +303,62 @@ class DeviceStore:
                             edges=dev(e), ekey=dev(ek),
                             num_keys=K, num_edges=E)
 
+    def filtered_merge_segment(self, pid: int, d: int,
+                               filters: list) -> MergeSegment | None:
+        """Merge segment of (pid, d) with edges restricted to targets that
+        satisfy every (fpid, fd, fconst) k2c filter — the device analogue of
+        the reference planner's type-centric pruning (planner.hpp type
+        tables): an expand followed by `?v type T` membership becomes ONE
+        expand over the pre-intersected segment. Host build is O(E + M)
+        numpy (searchsorted membership), cached per (pid, d, filters)."""
+        self._check_version()
+        fkey = tuple(sorted((int(p), int(dd), int(c)) for (p, dd, c)
+                            in filters))
+        key = ("mrgf", int(pid), int(d), fkey)
+        if key in self._cache:
+            self._touch(key)
+            return self._cache[key]
+        csr = self._host_csr(pid, d)
+        if csr is None:
+            return None
+        keys, offsets, edges = csr
+        edges = np.asarray(edges)
+        mask = np.ones(len(edges), dtype=bool)
+        for (fp, fd, fc) in fkey:
+            allowed = self._const_members(fp, fd, fc)
+            if len(allowed) == 0:
+                mask[:] = False
+                break
+            # allowed is sorted: O(E log M) membership, no big re-sort
+            pos = np.searchsorted(allowed, edges)
+            pos = np.clip(pos, 0, len(allowed) - 1)
+            mask &= allowed[pos] == edges
+        # per-key surviving counts without a Python loop
+        csum = np.concatenate([[0], np.cumsum(mask)])
+        new_deg = csum[offsets[1:]] - csum[offsets[:-1]]
+        keep_key = new_deg > 0
+        fkeys = np.asarray(keys)[keep_key]
+        fdeg = new_deg[keep_key]
+        foffs = np.zeros(len(fkeys) + 1, dtype=np.int64)
+        np.cumsum(fdeg, out=foffs[1:])
+        fedges = np.asarray(edges)[mask]
+        seg = self._stage_merge(fkeys, foffs, fedges)
+        self._insert(key, seg)
+        return seg
+
+    def _const_members(self, pid: int, d: int, const: int) -> np.ndarray:
+        """Host-side sorted { x : const ∈ adj(x, pid, d) } (see const_list)."""
+        pid, d, const = int(pid), int(d), int(const)
+        if pid == TYPE_ID and d == OUT:
+            host = self.g.get_index(const, IN)
+        elif pid == TYPE_ID and d == IN:
+            host = self.g.get_triples(const, TYPE_ID, OUT)
+        elif pid == PREDICATE_ID:
+            host = self.g.get_index(const, IN if d == OUT else OUT)
+        else:
+            host = self.g.get_triples(const, pid, IN if d == OUT else OUT)
+        return np.sort(np.asarray(host, dtype=np.int64))
+
     def const_list(self, pid: int, d: int, const: int):
         """Sorted set { x : const ∈ adj(x, pid, d) } staged on device — the
         k2c merge relation, matching the CPU oracle's _contains_many routing
@@ -308,19 +369,8 @@ class DeviceStore:
         if key in self._index_cache:
             self._touch(key)
             return self._index_cache[key]
-        pid, d, const = int(pid), int(d), int(const)
-        if pid == TYPE_ID and d == OUT:
-            host = self.g.get_index(const, IN)  # members of type `const`
-        elif pid == TYPE_ID and d == IN:
-            host = self.g.get_triples(const, TYPE_ID, OUT)  # types of `const`
-        elif pid == PREDICATE_ID:
-            # versatile: vertices with predicate `const` on the d side —
-            # index[(p, OUT)] holds p's objects, so the lookup flips d
-            host = self.g.get_index(const, IN if d == OUT else OUT)
-        else:
-            host = self.g.get_triples(const, pid, IN if d == OUT else OUT)
-        return self._stage_list(key, np.sort(np.asarray(host,
-                                                        dtype=np.int32)))
+        host = self._const_members(pid, d, const)
+        return self._stage_list(key, host.astype(np.int32))
 
     def _build_type_index_csr(self) -> DeviceSegment | None:
         """Type membership as one CSR keyed by type id (subject-side tidx)."""

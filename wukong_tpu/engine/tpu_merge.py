@@ -186,6 +186,7 @@ class MergeExecutor:
                 if p.predicate > 0]
         eng.dstore.pin(pins)
         try:
+            folds = self._plan_folds(pats, index_mode=False)
             flight = []
             for consts in consts_list:
                 B = len(consts)
@@ -194,7 +195,10 @@ class MergeExecutor:
                 state = _MergeState()
                 self._init_const(state, pats, consts)
                 for k in range(len(pats)):
-                    self._dispatch(q, pats[k], k, state, cap_override, {})
+                    if k in folds.get("skip", ()):
+                        continue
+                    self._dispatch(q, pats[k], k, state, cap_override, {},
+                                   folds.get(k))
                 counts = K.qid_counts_pos0(state.pos0(), state.n,
                                            state.live_mask(), B=B, r=1,
                                            slice_mode=False)
@@ -239,12 +243,15 @@ class MergeExecutor:
                 if p.predicate > 0]
         eng.dstore.pin(pins)
         try:
+            folds = self._plan_folds(pats, index_mode=(mode != "const"))
             for _attempt in range(8):
                 state = _MergeState()
                 first = init(state)
                 for k in range(first, len(pats)):
+                    if k in folds.get("skip", ()):
+                        continue
                     self._dispatch(q, pats[k], k, state, cap_override,
-                                   step_est)
+                                   step_est, folds.get(k))
                 counts = K.qid_counts_pos0(state.pos0(), state.n,
                                            state.live_mask(), B=B, r=r,
                                            slice_mode=slice_mode)
@@ -265,18 +272,65 @@ class MergeExecutor:
                         # learn downward too: the next call starts tight
                         cap_override.setdefault(s, exact)
                 if not over:
+                    if len(self._cap_memo) > 4096:  # bound BEFORE storing:
+                        self._cap_memo.clear()  # never wipe the fresh entry
                     self._cap_memo[memo_key] = dict(cap_override)
-                    if len(self._cap_memo) > 4096:
-                        self._cap_memo.clear()
                     return np.asarray(host_counts)
             raise WukongError(ErrorCode.UNKNOWN_PATTERN,
                               "batch capacity retry limit exceeded")
         finally:
             eng.dstore.unpin(pins)
 
+    @staticmethod
+    def _plan_folds(pats, index_mode: bool = True) -> dict:
+        """Fold k2c membership steps into their producing expand: a run of
+        `(?v, fp, fd, const)` membership steps immediately following the
+        expand that binds ?v becomes edge pre-filtering of that expand's
+        segment (DeviceStore.filtered_merge_segment — the type-centric
+        pruning of planner.hpp applied at execution time; conjunctive
+        semantics make the early filter exact). Returns
+        {expand_step: ([(fp, fd, fconst), ...], last_folded_step),
+         "skip": {folded steps}}.
+        """
+        folds: dict = {}
+        skip: set = set()
+        bound: set = set()
+        if pats:
+            bound.add(pats[0].subject)
+            # index mode: init consumes pattern 0 and pre-binds its object
+            # (a step-0 fold would never execute). const mode: step 0 runs
+            # as a real expand, so its object must stay foldable.
+            if index_mode and pats[0].object < 0:
+                bound.add(pats[0].object)
+        for k, pat in enumerate(pats):
+            is_expand = (pat.predicate >= 0 and pat.object < 0
+                         and pat.object not in bound)
+            if pat.object < 0:
+                bound.add(pat.object)
+            if not is_expand:
+                continue
+            v = pat.object
+            fl = []
+            last = k
+            for j in range(k + 1, len(pats)):
+                nxt = pats[j]
+                if (nxt.subject == v and nxt.predicate >= 0
+                        and nxt.object > 0):
+                    fl.append((nxt.predicate, int(nxt.direction),
+                               nxt.object))
+                    skip.add(j)
+                    last = j
+                else:
+                    break
+            if fl:
+                folds[k] = (fl, last)
+        folds["skip"] = skip
+        return folds
+
     # ------------------------------------------------------------------
     def _dispatch(self, q, pat, step: int, state: _MergeState,
-                  cap_override: dict, step_est: dict) -> None:
+                  cap_override: dict, step_est: dict,
+                  fold_filters: list | None = None) -> None:
         import jax.numpy as jnp
 
         eng = self.eng
@@ -294,8 +348,13 @@ class MergeExecutor:
 
         e_known = end < 0 and end in state.var_level
         if end < 0 and not e_known:  # expand
-            seg = eng.dstore.merge_segment(pid, d)
-            if seg is None:
+            if fold_filters is not None:
+                filters, last_step = fold_filters
+                seg = eng.dstore.filtered_merge_segment(pid, d, filters)
+            else:
+                filters, last_step = None, step
+                seg = eng.dstore.merge_segment(pid, d)
+            if seg is None or seg.num_edges == 0:
                 state.levels.append(_Level(
                     end, jnp.zeros(state.cap, jnp.int32),
                     jnp.zeros(state.cap, jnp.int32)))
@@ -303,7 +362,9 @@ class MergeExecutor:
                 state.n = jnp.int32(0)
                 state.live = None
                 return
-            est = step_est.get(step)
+            # folded filters make the POST-filter estimate (the last folded
+            # step's) the right capacity driver
+            est = step_est.get(last_step)
             if est is None:
                 # live-row estimate, never capacity (capacity compounds
                 # geometrically and would inflate every later sort)
