@@ -312,16 +312,21 @@ class MergeExecutor:
             v = pat.object
             fl = []
             last = k
+            consec = True
             for j in range(k + 1, len(pats)):
                 nxt = pats[j]
                 if (nxt.subject == v and nxt.predicate >= 0
-                        and nxt.object > 0):
+                        and nxt.object > 0 and j not in skip):
+                    # conjunctive semantics: ANY later k2c on v folds into
+                    # the producing expand; only a CONSECUTIVE run's last
+                    # step keeps a meaningful post-filter row estimate
                     fl.append((nxt.predicate, int(nxt.direction),
                                nxt.object))
                     skip.add(j)
-                    last = j
+                    if consec:
+                        last = j
                 else:
-                    break
+                    consec = False
             if fl:
                 folds[k] = (fl, last)
         folds["skip"] = skip
