@@ -1,0 +1,15 @@
+#!/bin/bash
+# Refresh the frozen working-tree snapshot the opportunistic bench loop runs
+# from (.cache/benchsnap). Call after a green-tests commit so the loop never
+# measures a half-edited tree. World caches + partial results stay shared via
+# WUKONG_CACHE_DIR pointing back at the live tree's .cache.
+set -e
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+SNAP="$REPO/.cache/benchsnap"
+mkdir -p "$SNAP"
+cd "$REPO"
+# -co --exclude-standard: tracked AND new untracked sources (a new module
+# imported by a tracked file would otherwise be silently dropped, breaking
+# every bench pass in the loop with ModuleNotFoundError)
+git ls-files -coz --exclude-standard | tar --null -T - -cf - | tar -xf - -C "$SNAP"
+echo "benchsnap refreshed from $(git rev-parse --short HEAD)"

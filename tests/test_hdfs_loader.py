@@ -16,20 +16,23 @@ from wukong_tpu.loader import hdfs
 from wukong_tpu.utils.errors import WukongError
 
 FAKE_HDFS = r"""#!/bin/sh
-# fake `hdfs dfs` CLI: maps hdfs://fake/<path> onto $FAKE_HDFS_ROOT/<path>
+# fake `hdfs dfs` CLI: maps hdfs://fake/<path> onto $FAKE_HDFS_ROOT/<path>.
+# -ls prints real `hdfs dfs -ls` shaped lines (permission string first, path
+# last; directories lead with 'd') so list_dir's file/dir split is exercised.
 [ "$1" = "dfs" ] || exit 2
 shift
 case "$1" in
   -ls)
-    [ "$2" = "-C" ] || exit 2
-    dir="${3#hdfs://fake}"
+    dir="${2#hdfs://fake}"
     for f in "$FAKE_HDFS_ROOT$dir"/*; do
-      [ -e "$f" ] && echo "hdfs://fake$dir/$(basename "$f")"
+      [ -e "$f" ] || continue
+      if [ -d "$f" ]; then perm="drwxr-xr-x"; else perm="-rw-r--r--"; fi
+      echo "$perm   3 user group  42 2026-01-01 00:00 hdfs://fake$dir/$(basename "$f")"
     done
     ;;
   -get)
     src="${2#hdfs://fake}"
-    cp "$FAKE_HDFS_ROOT$src" "$3"
+    cp -r "$FAKE_HDFS_ROOT$src" "$3"
     ;;
   *) exit 2 ;;
 esac
@@ -105,6 +108,18 @@ def test_resolve_passthrough_and_scheme(fake_hdfs, tmp_path):
     from wukong_tpu.loader.base import load_triples
 
     assert load_triples(staged_b).tolist() == [[200007, 131073, 200008]]
+
+
+def test_subdirectory_is_skipped(fake_hdfs, tmp_path):
+    """A directory whose name matches the wanted prefixes (e.g. `preshard/`)
+    must not be fetched: `-get` copies directories recursively, leaving a
+    subdir the flat POSIX staging pipeline chokes on (advisor r2 #3)."""
+    _write_dataset(fake_hdfs, [[200000, 131073, 200001]])
+    sub = fake_hdfs / "preshard"
+    sub.mkdir()
+    (sub / "junk").write_text("nested\n")
+    staged = hdfs.fetch_dataset("hdfs://fake/data", str(tmp_path / "stage"))
+    assert sorted(os.listdir(staged)) == ["id_triples.npy", "str_index"]
 
 
 def test_empty_remote_dir_raises(fake_hdfs):

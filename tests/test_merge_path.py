@@ -181,3 +181,38 @@ def test_merge_forced_compaction_matches(engines, world, monkeypatch):
     q2 = _parse(ss, f"{BASIC}/lubm_q1")
     got = tpu.execute_batch_index(q2, 2).tolist()
     assert got == want
+
+
+@pytest.mark.parametrize("qfile", QUERIES,
+                         ids=[os.path.basename(f) for f in QUERIES])
+def test_stream_expand_in_executor(engines, world, qfile, monkeypatch):
+    """Force the Pallas streaming expand (interpret mode) through the whole
+    merge executor: counts must match the oracle for every benchmark query.
+    Slice mode keeps step-1 anchors distinct (stream path proper); replicate
+    mode duplicates them (exercises the in-cond XLA fallback)."""
+    from wukong_tpu.engine import tpu_stream
+
+    cpu, tpu = engines
+    g, ss = world
+    monkeypatch.setattr(tpu_stream, "FORCE_INTERPRET", True)
+    # density gate off so even sparse expands take the kernel
+    monkeypatch.setattr(tpu_stream, "want_stream",
+                        lambda est, ne, cap: cap % tpu_stream.TILE == 0)
+
+    oracle = _parse(ss, qfile)
+    oracle.result.blind = False
+    cpu.execute(oracle)
+    want = oracle.result.nrows
+
+    q = _parse(ss, qfile)
+    Global.enable_merge_join = True
+    if q.start_from_index():
+        counts = tpu.execute_batch_index(q, 2, slice_mode=True)
+        assert int(counts.sum()) == want
+        q2 = _parse(ss, qfile)
+        counts = tpu.execute_batch_index(q2, 2)  # replicate: dup fallback
+        assert counts.tolist() == [want] * 2
+    else:
+        const = q.pattern_group.patterns[0].subject
+        counts = tpu.execute_batch(q, np.full(2, const, dtype=np.int64))
+        assert counts.tolist() == [want] * 2

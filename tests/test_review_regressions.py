@@ -344,3 +344,50 @@ def test_device_store_index_lru_evictable():
     assert ds.bytes_used <= max(
         (v[0].size * 4 for v in ds._index_cache.values()), default=0)
     del np
+
+
+def test_expand_total_saturates_on_int32_wrap():
+    """advisor r2 #1: an expansion total past 2^31 must saturate to INT32_MAX
+    (tripping the host's exceeds-capacity error) instead of wrapping to a
+    value that silently passes the `total > cap` check."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from wukong_tpu.engine.tpu_kernels import INT32_MAX, _saturate_total
+
+    deg = jnp.full(4096, 1 << 20, jnp.int32)  # exact total = 2^32 > int32
+    cum = jnp.cumsum(deg)
+    assert int(cum[-1]) >= 0  # the wrapped value IS deceptive (== 0 here)
+    assert int(_saturate_total(cum)) == INT32_MAX
+    ok = jnp.cumsum(jnp.full(1024, 7, jnp.int32))
+    assert int(_saturate_total(ok)) == 7 * 1024
+
+
+def test_merge_chain_pins_match_staged_keys():
+    """advisor r2 #2: the merge chain must pin the keys it actually stages —
+    folded expands use ("mrgf", ...) and k2c steps use ("rev", ...); pinning
+    only ("mrg", ...) leaves them evictable mid-chain."""
+    from wukong_tpu.engine.tpu_merge import MergeExecutor
+    from wukong_tpu.sparql.ir import Pattern
+    from wukong_tpu.types import IN, OUT, TYPE_ID
+
+    # q3-shaped: index start, expands with foldable type filters, k2k, plus
+    # a k2c on the ROOT var (no producing expand -> a real "rev" staging)
+    pats = [Pattern(-1, TYPE_ID, IN, 300000),      # index start (consumed)
+            Pattern(-1, 140000, OUT, -2),          # expand ?x -> ?y
+            Pattern(-2, TYPE_ID, OUT, 300001),     # folds into expand 1
+            Pattern(-1, 140001, OUT, -3),          # expand ?x -> ?z
+            Pattern(-3, 140002, OUT, -2),          # k2k pair membership
+            Pattern(-3, TYPE_ID, OUT, 300002),     # folds into expand 3
+            Pattern(-1, 140003, OUT, 200123)]      # root k2c -> "rev" list
+    folds = MergeExecutor._plan_folds(pats, index_mode=True)
+    pins = MergeExecutor._chain_pins(pats, folds, index_mode=True)
+    assert ("mrgf", 140000, int(OUT), ((int(TYPE_ID), int(OUT), 300001),)) \
+        in pins
+    assert ("mrgf", 140001, int(OUT), ((int(TYPE_ID), int(OUT), 300002),)) \
+        in pins
+    assert ("mrg", 140002, int(OUT)) in pins
+    assert ("rev", 140003, int(OUT), 200123) in pins
+    # folded steps must NOT appear as separate pins
+    assert not any(k[0] == "rev" and k[-1] in (300001, 300002) for k in pins)
+    assert len(pins) == 4

@@ -36,6 +36,9 @@ class GlobalConfig:
 
     # ---- mutable at runtime (config.hpp:112-151) ----
     enable_planner: bool = True
+    # skip execution when the planner proves the result empty from exact
+    # stats (planner.hpp:1505-1509 is_empty). Off => the full chain runs.
+    enable_empty_shortcircuit: bool = True
     enable_vattr: bool = False  # attribute-triple queries
     enable_corun: bool = False
     silent: bool = True  # blind mode: don't ship result tables to the proxy
@@ -46,6 +49,8 @@ class GlobalConfig:
     gpu_enable_pipeline: bool = True  # prefetch next pattern's segments to HBM
     enable_pallas: bool = True  # Pallas probe kernel on TPU backends
     enable_fp_probe: bool = True  # fingerprint-packed hash probe (XLA path)
+    # Pallas streaming merge-expand for dense heavy expansions (tpu_stream)
+    enable_stream_expand: bool = True
 
     # ---- TPU-engine knobs (new; no reference analogue) ----
     table_capacity_min: int = 1024  # smallest binding-table capacity class

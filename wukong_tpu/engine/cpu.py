@@ -94,7 +94,18 @@ class CPUEngine:
     # top-level state machine (sparql.hpp:1564-1673)
     # ------------------------------------------------------------------
     def execute(self, q: SPARQLQuery, from_proxy: bool = True) -> SPARQLQuery:
+        from wukong_tpu.config import Global
+
         try:
+            if q.planner_empty and Global.enable_empty_shortcircuit:
+                # planner proved the conjunction empty from exact type stats
+                # (planner.hpp:1505-1509 "identified empty result query"):
+                # bind the pattern vars over a zero-row table and skip
+                # execution entirely
+                self.short_circuit_empty(q)
+                if from_proxy:
+                    self._final_process(q)
+                return q
             if q.has_pattern and not q.done_patterns():
                 self._execute_patterns(q)
             if q.pattern_group.unions and not q.union_done:
@@ -109,6 +120,28 @@ class CPUEngine:
         except WukongError as e:
             q.result.status_code = e.code
         return q
+
+    def short_circuit_empty(self, q: SPARQLQuery) -> None:
+        """Materialize the provably-empty result: bind every pattern var over
+        a zero-row table (column order = first-mention order, the same
+        convention the kernels use) and mark all stages done, so downstream
+        consumers (projection, monitor, batch counting) see a normal reply."""
+        res = q.result
+        for pat in (q.pattern_group.patterns
+                    + [p for g in q.pattern_group.optional for p in g.patterns]):
+            for var in (pat.subject, pat.predicate, pat.object):
+                if var < 0 and res.var2col(var) == NO_RESULT:
+                    if var == pat.object and pat.pred_type != int(AttrType.SID_t):
+                        res.add_var2col(var, res.attr_col_num, pat.pred_type)
+                        res.attr_col_num += 1
+                    else:
+                        res.add_var2col(var, res.col_num)
+                        res.col_num += 1
+        res.set_table(np.empty((0, res.col_num), dtype=np.int64))
+        res.attr_table = np.empty((0, res.attr_col_num), dtype=np.float64)
+        q.pattern_step = len(q.pattern_group.patterns)
+        q.union_done = True
+        q.optional_step = len(q.pattern_group.optional)
 
     def _execute_patterns(self, q: SPARQLQuery) -> None:
         from wukong_tpu.config import Global

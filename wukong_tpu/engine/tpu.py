@@ -96,6 +96,14 @@ class TPUEngine:
     # ------------------------------------------------------------------
     def execute(self, q: SPARQLQuery, from_proxy: bool = True) -> SPARQLQuery:
         try:
+            if q.planner_empty and Global.enable_empty_shortcircuit:
+                # planner-proved empty (planner.hpp:1505-1509): no device
+                # work at all — the chain would stage segments and compile
+                # only to produce zero rows
+                self.cpu.short_circuit_empty(q)
+                if from_proxy:
+                    self.cpu._final_process(q)
+                return q
             if q.has_pattern and not q.done_patterns():
                 self._run_pattern_chain(q)
             if q.pattern_group.unions and not q.union_done:
@@ -333,6 +341,8 @@ class TPUEngine:
                           "batch steps must anchor on a bound column")
             probe.bind(pat)
         B = len(consts)
+        if q.planner_empty and Global.enable_empty_shortcircuit:
+            return np.zeros(B, dtype=np.int64)
         if Global.enable_merge_join and self.merge.supports(q):
             return self.merge.run_batch_const(q, consts)
 
@@ -382,6 +392,8 @@ class TPUEngine:
                           ErrorCode.UNKNOWN_PATTERN,
                           "batch steps must anchor on a bound column")
                 probe.bind(pat)
+        if q.planner_empty and Global.enable_empty_shortcircuit:
+            return np.zeros(B, dtype=np.int64)
         if Global.enable_merge_join and self.merge.supports(q):
             return self.merge.run_batch_index(q, B, slice_mode)
         edges, real = self.dstore.index_list(pats[0].subject, pats[0].direction)

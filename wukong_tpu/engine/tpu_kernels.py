@@ -277,6 +277,19 @@ def _range_member(edges, lo, hi, vals, depth: int):
 # ---------------------------------------------------------------------------
 
 
+def _saturate_total(cum):
+    """True expansion total from an int32 degree cumsum, saturated to
+    INT32_MAX on wraparound. Each degree is < 2^31, so the first time the
+    exact prefix passes 2^31 the wrapped value lands in [-2^31, 0) — some
+    prefix is negative iff the exact total exceeded int32 range. Without
+    this, a wrapped (possibly positive) total could silently pass the host's
+    `total > cap` overflow check and truncate rows; saturation instead
+    trips the exceeds-capacity error (total > cap_max) deterministically.
+    (x64 is disabled process-wide, so an int64 cumsum is not available.)"""
+    wrapped = jnp.any(cum < 0)
+    return jnp.where(wrapped, jnp.int32(INT32_MAX), cum[-1])
+
+
 def _probe(bkey, bstart, bdeg, cur, n, max_probe: int, use_pallas: bool,
            fpw0=None, fpw1=None, fp_dup: int = 0):
     """Probe dispatch. `use_pallas` and `fp_dup` are the caller's STATIC
@@ -310,7 +323,7 @@ def expand(table, n, bkey, bstart, bdeg, edges, col, cap_out, max_probe,
     found, start, deg = _probe(bkey, bstart, bdeg, cur, n, max_probe,
                                use_pallas, fpw0, fpw1, fp_dup)
     cum = jnp.cumsum(deg)
-    total = cum[C - 1]
+    total = _saturate_total(cum)
     starts_excl = cum - deg
     # scatter each live row's id at its output start; running max fills gaps
     park = jnp.where(deg > 0, starts_excl, cap_out)
@@ -486,6 +499,27 @@ def _merge_lookup(skey, sstart, sdeg, cur):
     return ks, ts, found, start, deg, is_seg
 
 
+def _emit_gather(ts, S, start, deg, st_ex, edges, total, cap_out):
+    """The scatter+cummax+gather emit over the [cap_out] output grid (shared
+    by merge_expand and tpu_stream's duplicate-anchor fallback branch).
+    Returns (val, parent), zero-masked outside [0, total)."""
+    base = start - st_ex  # eidx = base[src] + j (one gather instead of two)
+    M = ts.shape[0]
+    mrows = jnp.arange(M, dtype=jnp.int32)
+    park = jnp.where(deg > 0, st_ex, cap_out)
+    marks = jnp.zeros(cap_out, dtype=jnp.int32).at[park].max(
+        mrows + 1, mode="drop")
+    src = jax.lax.cummax(marks) - 1
+    srcc = jnp.clip(src, 0, M - 1)
+    j = jnp.arange(cap_out, dtype=jnp.int32)
+    E = edges.shape[0]
+    eidx = base[srcc] + j
+    val = edges[jnp.clip(eidx, 0, E - 1)]
+    parent = ts[srcc] - S
+    out_ok = (j < total) & (src >= 0)
+    return jnp.where(out_ok, val, 0), jnp.where(out_ok, parent, 0)
+
+
 @partial(jax.jit, static_argnames=("cap_out",))
 def merge_expand(skey, sstart, sdeg, edges, cur, n, live, cap_out):
     """known_to_unknown without probes: returns (val [cap_out],
@@ -503,24 +537,11 @@ def merge_expand(skey, sstart, sdeg, edges, cur, n, live, cap_out):
     ks, ts, found, start, deg, is_seg = _merge_lookup(skey, sstart, sdeg, curm)
     deg = jnp.where(is_seg, 0, deg)
     cum = jnp.cumsum(deg)
-    total = cum[-1]
+    total = _saturate_total(cum)
     st_ex = cum - deg
-    base = start - st_ex  # eidx = base[src] + j (one gather instead of two)
-    M = ks.shape[0]
-    mrows = jnp.arange(M, dtype=jnp.int32)
-    park = jnp.where(deg > 0, st_ex, cap_out)
-    marks = jnp.zeros(cap_out, dtype=jnp.int32).at[park].max(
-        mrows + 1, mode="drop")
-    src = jax.lax.cummax(marks) - 1
-    srcc = jnp.clip(src, 0, M - 1)
-    j = jnp.arange(cap_out, dtype=jnp.int32)
-    E = edges.shape[0]
-    eidx = base[srcc] + j
-    val = edges[jnp.clip(eidx, 0, E - 1)]
-    parent = ts[srcc] - skey.shape[0]
-    out_ok = (j < total) & (src >= 0)
-    return (jnp.where(out_ok, val, 0),
-            jnp.where(out_ok, parent, 0),
+    val, parent = _emit_gather(ts, skey.shape[0], start, deg, st_ex, edges,
+                               total, cap_out)
+    return (val, parent,
             jnp.minimum(total, cap_out).astype(jnp.int32), total)
 
 

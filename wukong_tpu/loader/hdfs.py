@@ -65,10 +65,20 @@ def _run(args: list[str]) -> str:
 
 
 def list_dir(hdfs_dir: str) -> list[str]:
-    """Paths directly under an HDFS directory (`-ls -C` prints bare paths,
-    playing hdfs_loader.hpp:33-45's list_files role)."""
-    out = _run(["-ls", "-C", hdfs_dir])
-    return [ln.strip() for ln in out.splitlines() if ln.strip()]
+    """FILE paths directly under an HDFS directory (playing
+    hdfs_loader.hpp:33-45's list_files role). Parses full `-ls` output so
+    directories can be skipped — `-ls -C` prints both, and `-get` on a
+    directory copies it recursively, leaving a subdirectory the flat POSIX
+    staging pipeline does not expect (advisor r2 #3)."""
+    out = _run(["-ls", hdfs_dir])
+    paths = []
+    for ln in out.splitlines():
+        # permission-string lines: "-rw-r--r-- 3 user grp size date time path";
+        # bounded split keeps paths containing spaces intact
+        parts = ln.split(None, 7)
+        if len(parts) == 8 and parts[0][0] == "-":
+            paths.append(parts[7])
+    return paths
 
 
 # files the POSIX pipeline understands (loader/base.py + string_server +

@@ -94,6 +94,12 @@ class DistEngine:
         shard_map chains; UNION branches and OPTIONAL groups run as seeded
         distributed children; FILTER/FINAL run host-side on the gathered
         table (they touch strings and projections, not the graph)."""
+        if q.planner_empty and Global.enable_empty_shortcircuit:
+            # planner-proved empty: no sharded chain, no collectives
+            self._host().short_circuit_empty(q)
+            if from_proxy:
+                self._host()._final_process(q)
+            return
         assert_ec(not (q.result.blind
                        and (q.pattern_group.filters or q.pattern_group.unions
                             or q.pattern_group.optional)),

@@ -52,6 +52,15 @@ class _State:
     ttab: dict  # {(t_1, ..., t_k): count} joint type distribution
     cost: float
     plan: list
+    # some executed subset of patterns has EXACTLY zero mass under the
+    # (complete) type statistics — the whole conjunction is provably empty
+    # (reference is_empty, planner.hpp:1505-1509). Rows are floored for
+    # cost arithmetic, so emptiness rides as a separate flag.
+    empty: bool = False
+    # emptiness proofs are only sound while the joint table is exact as a
+    # SET of type combinations: _prune truncation drops combos whose types
+    # might survive a later filter, so it clears this and disables proofs
+    exact: bool = True
 
 
 def _prune(ttab: dict) -> dict:
@@ -86,6 +95,14 @@ class Planner:
             heuristic_plan(q)
             return True
         pg.patterns[:] = [pat for (pat, _src) in best]
+        # provably-empty conjunction (reference "identified empty result
+        # query", planner.hpp:1505-1509): engines may skip execution. Sound
+        # with filters (only remove rows) and OPTIONAL (left join keeps only
+        # parent rows), but NOT with UNION — a branch starting from its own
+        # index explores independently of the (empty) parent table.
+        q.planner_empty = bool(self._best_state is not None
+                               and self._best_state.empty
+                               and not pg.unions)
         for u in pg.unions:
             sub = SPARQLQuery()
             sub.pattern_group = u
@@ -97,6 +114,7 @@ class Planner:
         pats = list(pg.patterns)
         self._best_cost = float("inf")
         self._best_plan = None
+        self._best_state = None
         for start_state in self._start_candidates(pats):
             self._dfs(start_state, pats)
         return self._best_plan
@@ -108,6 +126,7 @@ class Planner:
         if not remaining:
             self._best_cost = state.cost
             self._best_plan = state.plan
+            self._best_state = state
             return
         cands = []
         for p in remaining:
@@ -199,12 +218,16 @@ class Planner:
         return self._norm(dist, deg)
 
     def _mk_start(self, pat: Pattern, consumes, var: int, dist):
+        # an exactly-empty start distribution (type with no entities /
+        # predicate with no edges) already proves the query empty — the
+        # stats enumerate every (type, pred, dir) that occurs in the graph
+        empty = not any(c > 0 for c in (dist or {}).values())
         dist = {t: c for t, c in (dist or {}).items() if c > 0} or {0: 1.0}
         rows = sum(dist.values())
         return _State(rows=max(rows, 1.0), vars=(var,),
                       ttab={(t,): c for t, c in dist.items()},
                       cost=INIT_COST + rows * COST_PRODUCE,
-                      plan=[(pat, consumes)])
+                      plan=[(pat, consumes)], empty=empty)
 
     def _const_fanout(self, pid: int, d: int) -> float:
         """Average neighbor count of one constant: edges / distinct anchors
@@ -243,7 +266,8 @@ class Planner:
             return _State(rows, state.vars + nvars, ttab,
                           state.cost + INIT_COST + state.rows * COST_SCAN
                           + rows * COST_PRODUCE,
-                          state.plan + [(self._orient(state, p), p)])
+                          state.plan + [(self._orient(state, p), p)],
+                          empty=state.empty, exact=state.exact)
         if not (s_var_b or o_var_b):
             return None
         oriented = p if pre_oriented else self._orient(state, p)
@@ -275,12 +299,35 @@ class Planner:
             keep = set(st.types_containing(oriented.object))
             ttab = {types: c for types, c in state.ttab.items()
                     if types[ia] in keep}
+            # zero surviving mass with an exact table = no binding of the
+            # anchor var can have the target type -> provably empty. Rows
+            # with anchor type 0 (versatile vars of unknown type) could
+            # still match, so they void the proof.
+            empty = state.empty or (
+                state.exact and not ttab
+                and all(types[ia] != 0 for types in state.ttab))
             rows = max(sum(ttab.values()), 0.01)
             return _State(rows, state.vars, ttab or {(0,) * len(state.vars): rows},
                           state.cost + INIT_COST + state.rows * COST_PROBE,
-                          state.plan + [(oriented, p)])
+                          state.plan + [(oriented, p)],
+                          empty=empty, exact=state.exact)
 
         if oriented.object < 0 and oriented.object not in state.vars:
+            if oriented.predicate in (TYPE_ID, PREDICATE_ID):
+                # meta-predicate expansion (?x rdf:type ?t, __PREDICATE__):
+                # fine_type deliberately excludes rdf:type edges, so a
+                # missing entry must NOT read as "no edges" — every typed
+                # entity has them. The new var holds type/pred ids (type 0).
+                fan = 1.5 if oriented.predicate == TYPE_ID else 8.0
+                rows_out = state.rows * fan
+                ttab = {types + (0,): c * fan
+                        for types, c in state.ttab.items()}
+                return _State(rows_out, state.vars + (oriented.object,),
+                              ttab,
+                              state.cost + INIT_COST + state.rows * COST_SCAN
+                              + rows_out * COST_PRODUCE,
+                              state.plan + [(oriented, p)],
+                              empty=state.empty, exact=state.exact)
             # expansion: each joint row splits by the anchor type's fine_type
             # neighbor distribution
             ttab: dict[tuple, float] = {}
@@ -303,12 +350,18 @@ class Planner:
                     key = types + (nt,)
                     ttab[key] = ttab.get(key, 0.0) + share
                     rows_out += share
+            # zero produced mass is exact: fine_type enumerates every
+            # (type, pred, dir) with edges, and untyped anchors (t == 0)
+            # contribute a positive fallback fanout, never a false zero
+            empty = state.empty or (state.exact and rows_out == 0.0)
+            pruned = len(ttab) > MAX_TTAB_ROWS
             rows_out = max(rows_out, 0.0)
             return _State(rows_out, state.vars + (oriented.object,),
                           _prune(ttab) or {(0,) * (len(state.vars) + 1): 0.01},
                           state.cost + INIT_COST + state.rows * COST_SCAN
                           + rows_out * COST_PRODUCE,
-                          state.plan + [(oriented, p)])
+                          state.plan + [(oriented, p)],
+                          empty=empty, exact=state.exact and not pruned)
 
         # membership (k2k / k2c): per-row selectivity conditioned on the
         # anchor row's type (and the other endpoint's type for k2k)
@@ -332,11 +385,14 @@ class Planner:
                                     for nt in targets)) or 1.0
                     sel = (ec / t_pop) / pop
             else:  # k2k: edge to the row's specific o-instance
-                if not ft:  # untyped: global density
+                io = state.vars.index(oriented.object)
+                to = types[io]
+                if not ft or to == 0:  # untyped endpoint: global density
+                    # (to == 0 must not yield an exact 0 — the endpoint's
+                    # type is unknown, so a 0 here would be a false
+                    # emptiness proof downstream)
                     sel = pe / (sp * op)
                 else:
-                    io = state.vars.index(oriented.object)
-                    to = types[io]
                     ec = float(ft.get(to, 0))
                     pop = float(st.tyscount.get(to, 1)) or 1.0
                     sel = (ec / t_pop) / pop
@@ -344,11 +400,16 @@ class Planner:
             if c * sel > 0:
                 ttab[types] = ttab.get(types, 0.0) + c * sel
                 rows += c * sel
+        # zero mass is exact here too: the untyped branches above always
+        # yield positive densities, so sel == 0 only comes from exhaustive
+        # fine_type entries (no edges of this pred between these types)
+        empty = state.empty or (state.exact and rows == 0.0)
         rows = max(rows, 0.01)
         return _State(rows, state.vars,
                       ttab or {(0,) * len(state.vars): rows},
                       state.cost + INIT_COST + state.rows * COST_PROBE,
-                      state.plan + [(oriented, p)])
+                      state.plan + [(oriented, p)],
+                      empty=empty, exact=state.exact)
 
     # ------------------------------------------------------------------
     def estimate_chain(self, patterns: list) -> list | None:

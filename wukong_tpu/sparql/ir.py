@@ -172,6 +172,11 @@ class SPARQLQuery:
     offset: int = 0
     distinct: bool = False
     local_var: int = 0
+    # planner proved the result empty from exact type statistics (the
+    # reference's is_empty short-circuit, planner.hpp:1505-1509: "identified
+    # empty result query" — generate_plan returns false and the proxy skips
+    # execution). Engines honor it under Global.enable_empty_shortcircuit.
+    planner_empty: bool = False
 
     def get_pattern(self, step: int | None = None) -> Pattern:
         s = self.pattern_step if step is None else step
