@@ -285,18 +285,28 @@ def test_dist_attr_suite(attr_world, qn, monkeypatch):
                        np.sort(np.asarray(qd.result.attr_table), axis=0))
 
 
-def test_dist_blind_rejects_optional_union(world):
+def test_dist_blind_optional_union_silent_parity(world):
+    """Reference silent mode works for ANY shape (it executes and just never
+    ships the table, query.hpp:619-630): blind + OPTIONAL must return the
+    true row count with an empty table, matching the non-blind row count."""
     ss, cpu, dist = world
     text = f"""PREFIX ub: <{UB}>
     SELECT ?S ?UG ?DOC WHERE {{
         ?S ub:undergraduateDegreeFrom ?UG .
         OPTIONAL {{ ?S ub:doctoralDegreeFrom ?DOC }} .
     }}"""
+    qfull = Parser(ss).parse(text)
+    heuristic_plan(qfull)
+    dist.execute(qfull)
+    assert qfull.result.status_code == 0
+
     q = Parser(ss).parse(text)
     heuristic_plan(q)
     q.result.blind = True
     dist.execute(q)
-    assert q.result.status_code != 0  # clean rejection, no garbage tables
+    assert q.result.status_code == 0
+    assert q.result.nrows == qfull.result.nrows > 0
+    assert q.result.table.size == 0  # the table itself is never shipped
 
 
 def test_dist_optional_filter_on_parent_var(world):

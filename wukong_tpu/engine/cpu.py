@@ -636,26 +636,59 @@ class CPUEngine:
                     pg.optional_new_vars.add(fldv)
 
     def _reorder_optional_patterns(self, pg: PatternGroup, res: Result) -> None:
-        """Restrictive patterns first (query.hpp:736-781)."""
-        restrictive, k2u, c2u, unknown = [], [], [], []
-        for p in pg.patterns:
-            if is_tpid(p.subject):
-                if res.var2col(p.object) != NO_RESULT:
-                    restrictive.append(p)
+        """Restrictive patterns first (query.hpp:736-781), greedily
+        re-simulating bindings: a var UNKNOWN against the parent result may
+        become known through an EARLIER group pattern, so classification
+        runs round by round over the growing bound set. Patterns whose only
+        bound endpoint is the OBJECT are oriented to expand along IN (the
+        planner does this for main-group patterns; optional groups are
+        planned here, at execution time)."""
+        bound = {v for v in res.v2c_map if res.var2col(v) != NO_RESULT}
+        bound |= set(res.attr_v2c_map)
+        remaining = list(pg.patterns)
+        out = []
+
+        def stat(v):
+            if v >= 0:
+                return CONST_VAR
+            return KNOWN_VAR if v in bound else UNKNOWN_VAR
+
+        while remaining:
+            best = None  # (rank, idx, oriented_pattern)
+            for i, p in enumerate(remaining):
+                if is_tpid(p.subject):
+                    rank = 0 if stat(p.object) != UNKNOWN_VAR else 2
+                    cand = p
                 else:
-                    c2u.append(p)
-                continue
-            key = (var_stat(res, p.subject), var_stat(res, p.object))
-            if key in ((CONST_VAR, KNOWN_VAR), (KNOWN_VAR, CONST_VAR),
-                       (KNOWN_VAR, KNOWN_VAR)):
-                restrictive.append(p)
-            elif key == (CONST_VAR, UNKNOWN_VAR):
-                c2u.append(p)
-            elif key == (KNOWN_VAR, UNKNOWN_VAR):
-                k2u.append(p)
-            else:
-                unknown.append(p)
-        pg.patterns[:] = restrictive + k2u + c2u + unknown
+                    key = (stat(p.subject), stat(p.object))
+                    if UNKNOWN_VAR not in key:
+                        rank, cand = 0, p
+                    elif key[0] in (CONST_VAR, KNOWN_VAR):
+                        rank = 1 if key[0] == KNOWN_VAR else 2
+                        cand = p
+                    elif key[1] in (CONST_VAR, KNOWN_VAR):
+                        rank = 1 if key[1] == KNOWN_VAR else 2
+                        # flip, don't hardcode: a plan-file '<' pattern is
+                        # already IN, and its object-anchored flip is OUT
+                        flip = IN if p.direction == OUT else OUT
+                        cand = Pattern(p.object, p.predicate, flip,
+                                       p.subject, p.pred_type)
+                    else:
+                        continue  # both endpoints unknown: not yet runnable
+                if best is None or rank < best[0]:
+                    best = (rank, i, cand)
+                    if rank == 0:
+                        break
+            if best is None:  # nothing executable: keep original order
+                out.extend(remaining)
+                break
+            _rank, i, cand = best
+            src = remaining.pop(i)
+            out.append(cand)
+            for v in (src.subject, src.predicate, src.object):
+                if v < 0:
+                    bound.add(v)
+        pg.patterns[:] = out
 
     # ------------------------------------------------------------------
     # FILTER (sparql.hpp:1158-1382)
@@ -726,9 +759,37 @@ class CPUEngine:
             return np.asarray([v] * res.nrows, dtype=object)
         raise WukongError(ErrorCode.UNKNOWN_FILTER, "unsupported filter operand")
 
+    @staticmethod
+    def _attr_operand(res: Result, f: Filter):
+        """Numeric row values when the operand involves an attribute var,
+        else None. (Beyond the reference: its FILTER path only compares
+        result_table strings — sparql.hpp:1158-1382 — so attr-var filters
+        are impossible there; here FILTER(?age > 21) works numerically.)"""
+        if f.type == FilterType.Variable and res.is_attr_var(f.valueArg):
+            col, _t = res.attr_v2c_map[f.valueArg]
+            return np.asarray(res.attr_table[:, col], dtype=np.float64)
+        if f.type == FilterType.Literal:
+            try:
+                return np.full(res.nrows, float(f.value.strip('"')))
+            except ValueError:
+                return None
+        return None
+
     def _relational_filter(self, f: Filter, res: Result, keep: np.ndarray) -> None:
-        a = self._row_strings(res, f.arg1)
-        b = self._row_strings(res, f.arg2)
+        # numeric comparison when either side is an attribute var
+        na, nb = self._attr_operand(res, f.arg1), self._attr_operand(res, f.arg2)
+        attr_cmp = (
+            (f.arg1.type == FilterType.Variable and res.is_attr_var(f.arg1.valueArg))
+            or (f.arg2.type == FilterType.Variable
+                and res.is_attr_var(f.arg2.valueArg)))
+        if attr_cmp:
+            assert_ec(na is not None and nb is not None,
+                      ErrorCode.UNKNOWN_FILTER,
+                      "attribute filters compare numbers")
+            a, b = na, nb
+        else:
+            a = self._row_strings(res, f.arg1)
+            b = self._row_strings(res, f.arg2)
         if f.type == FilterType.Equal:
             keep &= a == b
         elif f.type == FilterType.NotEqual:

@@ -100,12 +100,16 @@ class DistEngine:
             if from_proxy:
                 self._host()._final_process(q)
             return
-        assert_ec(not (q.result.blind
-                       and (q.pattern_group.filters or q.pattern_group.unions
-                            or q.pattern_group.optional)),
-                  ErrorCode.UNSUPPORTED_SHAPE,
-                  "blind mode supports pure BGPs only (FILTER/UNION/OPTIONAL "
-                  "children need the gathered table)")
+        # silent-mode parity (reference Global::silent works for ANY shape —
+        # it executes fully and simply never ships the table, query.hpp
+        # shrink 619-630): shapes whose children need the gathered table
+        # run non-blind internally and drop the table at reply time
+        blind_deferred = bool(
+            q.result.blind and (q.pattern_group.filters
+                                or q.pattern_group.unions
+                                or q.pattern_group.optional))
+        if blind_deferred:
+            q.result.blind = False
         if q.has_pattern and not q.done_patterns():
             self._execute_bgp(q)
         if q.pattern_group.unions and not q.union_done:
@@ -119,6 +123,14 @@ class DistEngine:
             self._host()._execute_filters(q)
         if from_proxy:
             self._host()._final_process(q)
+        if blind_deferred:
+            # drop the table at reply time; the count survives (shrink)
+            res = q.result
+            res.blind = True
+            nrows = res.nrows
+            res.table = np.empty((0, res.col_num), dtype=np.int64)
+            res.attr_table = np.empty((0, res.attr_col_num), np.float64)
+            res.nrows = nrows
 
     def _host(self):
         from wukong_tpu.engine.cpu import CPUEngine

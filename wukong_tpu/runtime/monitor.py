@@ -73,8 +73,13 @@ class Monitor:
         arr = np.sort(np.asarray(vals, dtype=np.float64))
         return {p: float(arr[min(int(p * len(arr)), len(arr) - 1)]) for p in points}
 
-    def print_cdf(self) -> None:
+    def print_cdf(self, labels: dict[int, str] | None = None) -> None:
+        """Per-class latency CDF. `labels` marks how a class was measured —
+        device-batch classes report batch_time/B, a different quantity from
+        a pool round-trip, and must not read as the same thing."""
         for qtype in sorted(self.latencies):
             c = self.cdf(qtype)
             line = "  ".join(f"p{int(p * 100)}={v:,.0f}us" for p, v in c.items())
-            log_info(f"Q{qtype + 1} latency CDF ({len(self.latencies[qtype])} samples): {line}")
+            tag = f" [{labels[qtype]}]" if labels and qtype in labels else ""
+            log_info(f"Q{qtype + 1}{tag} latency CDF "
+                     f"({len(self.latencies[qtype])} samples): {line}")
