@@ -54,6 +54,13 @@ TILE = 256  # edges per grid step (TILE//128 sublane rows per cumsum)
 # integration be exercised without TPU hardware)
 FORCE_INTERPRET = False
 
+# compaction backend: the one-hot plane either feeds two VPU masked
+# reductions (~6 passes over (2T, T)) or one MXU matmul on 16-bit halves
+# (~3 passes; exact — each output row selects at most one input, and halves
+# are < 2^16 so fp32 accumulation is lossless). stream_available() probes
+# the MXU variant first and flips to VPU if it fails to lower.
+USE_MXU_COMPACT = True
+
 _stream_state = {"ok": None}
 
 
@@ -61,24 +68,42 @@ def stream_available() -> bool:
     """One-time capability probe: compile + run a tiny stream_expand on the
     current backend (exercises the grid, SMEM carries, triangular matmuls,
     accumulator flush DMAs). Any failure permanently selects the XLA path."""
+    global USE_MXU_COMPACT
     if _stream_state["ok"] is None:
-        try:
-            if jax.devices()[0].platform != "tpu":
-                _stream_state["ok"] = False
-            else:
-                skey = jnp.asarray([3, INT32_MAX], jnp.int32)
-                sstart = jnp.asarray([0, 0], jnp.int32)
-                sdeg = jnp.asarray([2, 0], jnp.int32)
-                edges = jnp.arange(2 * TILE, dtype=jnp.int32)
-                cur = jnp.asarray([3] + [INT32_MAX] * 7, jnp.int32)
-                live = jnp.ones(8, bool)
-                v, p, n, t = stream_expand(skey, sstart, sdeg, edges, cur,
-                                           jnp.int32(1), live, cap_out=1024)
-                ok = (int(n) == 2 and v[0] == 0 and v[1] == 1
-                      and int(p[0]) == 0)
-                _stream_state["ok"] = bool(ok)
-        except Exception:
+        if jax.devices()[0].platform != "tpu":
             _stream_state["ok"] = False
+            return False
+
+        def _probe(mxu: bool) -> bool:
+            # edge values near INT32_MAX with odd low bits: a backend that
+            # lowers the compaction dot but truncates fp32 inputs (bf16
+            # passes) would corrupt exactly these, so the probe must use
+            # values that exercise both 16-bit halves at full width
+            big = INT32_MAX - 2
+            skey = jnp.asarray([3, INT32_MAX], jnp.int32)
+            sstart = jnp.asarray([0, 0], jnp.int32)
+            sdeg = jnp.asarray([2, 0], jnp.int32)
+            edges = jnp.full(2 * TILE, INT32_MAX, jnp.int32)
+            edges = edges.at[0].set(big).at[1].set(65_537)
+            cur = jnp.full(8, INT32_MAX, jnp.int32).at[5].set(3)
+            live = jnp.ones(8, bool)
+            v, p, n, t = stream_expand(skey, sstart, sdeg, edges, cur,
+                                       jnp.int32(6), live, cap_out=1024,
+                                       mxu=mxu)
+            return bool(int(n) == 2 and int(v[0]) == big
+                        and int(v[1]) == 65_537 and int(p[0]) == 5
+                        and int(p[1]) == 5)
+
+        ok = False
+        for mxu in ((True, False) if USE_MXU_COMPACT else (False,)):
+            try:
+                if _probe(mxu):
+                    USE_MXU_COMPACT = mxu
+                    ok = True
+                    break
+            except Exception:
+                continue
+        _stream_state["ok"] = ok
     return _stream_state["ok"]
 
 
@@ -152,7 +177,7 @@ def _psum_i32(x2, incl: bool):
 def _emit_kernel(edges_ref, dsel_ref, dpar_ref,
                  val_out, par_out, total_out,
                  stage_val, stage_par, acc_val, acc_par, sems, carry,
-                 *, cap_pad: int):
+                 *, cap_pad: int, mxu: bool):
     """Grid step t: integrate deltas over one edge tile, append the selected
     (value, parent) pairs to the VMEM accumulator, flush full aligned TILE
     blocks to HBM via async DMA (double-buffered staging).
@@ -197,10 +222,26 @@ def _emit_kernel(edges_ref, dsel_ref, dpar_ref,
     par_r = cpar.reshape(1, T)
     ii = jax.lax.broadcasted_iota(jnp.int32, (2 * T, T), 0)
     m2 = sel_r & (lrank_r == ii)
-    acc_val[...] = acc_val[...] + jnp.sum(
-        jnp.where(m2, es_r, 0), axis=1, keepdims=True)
-    acc_par[...] = acc_par[...] + jnp.sum(
-        jnp.where(m2, par_r, 0), axis=1, keepdims=True)
+    if mxu:
+        # one fp32 matmul on 16-bit halves instead of four VPU plane passes;
+        # es/cpar are >= 0 everywhere (pads are INT32_MAX, cpar holds the
+        # last run's parent between runs), so the shifts are sign-safe
+        mf = m2.astype(jnp.float32)  # (2T, T)
+        halves = jnp.concatenate([
+            (es_r >> 16).reshape(T, 1), (es_r & 0xFFFF).reshape(T, 1),
+            (par_r >> 16).reshape(T, 1), (par_r & 0xFFFF).reshape(T, 1),
+        ], axis=1).astype(jnp.float32)  # (T, 4)
+        out4 = jnp.dot(mf, halves,
+                       preferred_element_type=jnp.float32).astype(jnp.int32)
+        acc_val[...] = acc_val[...] + (out4[:, 0:1] * jnp.int32(1 << 16)
+                                       + out4[:, 1:2])
+        acc_par[...] = acc_par[...] + (out4[:, 2:3] * jnp.int32(1 << 16)
+                                       + out4[:, 3:4])
+    else:
+        acc_val[...] = acc_val[...] + jnp.sum(
+            jnp.where(m2, es_r, 0), axis=1, keepdims=True)
+        acc_par[...] = acc_par[...] + jnp.sum(
+            jnp.where(m2, par_r, 0), axis=1, keepdims=True)
     fnew = f + count
 
     def _wait_slot(slot):
@@ -262,7 +303,8 @@ def _emit_kernel(edges_ref, dsel_ref, dpar_ref,
         total_out[0, 0] = blk * T + f_end
 
 
-def _stream_emit(edges2, dsel2, dpar2, cap_out: int, interpret: bool = False):
+def _stream_emit(edges2, dsel2, dpar2, cap_out: int, interpret: bool = False,
+                 mxu: bool | None = None):
     """pallas_call wrapper: edges2/dsel2/dpar2 are [G, TILE]; returns
     (val [cap_pad, 1], par [cap_pad, 1], emitted [1]) with cap_pad =
     cap_out + TILE (the final partial block may carry zero garbage past the
@@ -274,7 +316,8 @@ def _stream_emit(edges2, dsel2, dpar2, cap_out: int, interpret: bool = False):
     T = TILE
     cap_pad = cap_out + T
     tile = pl.BlockSpec((1, T), lambda t: (t, 0), memory_space=pltpu.VMEM)
-    kern = partial(_emit_kernel, cap_pad=cap_pad)
+    kern = partial(_emit_kernel, cap_pad=cap_pad,
+                   mxu=USE_MXU_COMPACT if mxu is None else mxu)
     val, par, total = pl.pallas_call(
         kern,
         grid=(G,),
@@ -307,9 +350,9 @@ def _stream_emit(edges2, dsel2, dpar2, cap_out: int, interpret: bool = False):
 # ---------------------------------------------------------------------------
 
 
-@partial(jax.jit, static_argnames=("cap_out", "interpret"))
+@partial(jax.jit, static_argnames=("cap_out", "interpret", "mxu"))
 def stream_expand(skey, sstart, sdeg, edges, cur, n, live, cap_out: int,
-                  interpret: bool = False):
+                  interpret: bool = False, mxu: bool | None = None):
     """known_to_unknown expansion with the streaming emitter; identical
     contract and output order to tpu_kernels.merge_expand (edge order =
     key-sorted anchor order): (val [cap_out], parent [cap_out], out_n,
@@ -369,7 +412,8 @@ def stream_expand(skey, sstart, sdeg, edges, cur, n, live, cap_out: int,
         v2, p2, _tot = _stream_emit(ed.reshape(G, T),
                                     dsel[:Et].reshape(G, T),
                                     dpar[:Et].reshape(G, T),
-                                    cap_out=cap_out, interpret=interpret)
+                                    cap_out=cap_out, interpret=interpret,
+                                    mxu=mxu)
         return v2[:cap_out, 0], p2[:cap_out, 0]
 
     val, parent = jax.lax.cond(dup, _xla, _stream, None)
