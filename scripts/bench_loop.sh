@@ -12,14 +12,23 @@ export WUKONG_CACHE_DIR="$REPO/.cache"
 export WUKONG_BENCH_SCALE="${WUKONG_BENCH_SCALE:-2560}"
 export WUKONG_PROBE_TIMEOUT=90
 cd "$SNAP" || exit 1
+PASS=0
 while true; do
   if timeout 90 python -c "
 import jax, jax.numpy as jnp, sys
 jax.device_get(jnp.arange(2) + 1)
 sys.exit(0 if jax.devices()[0].platform != 'cpu' else 1)" >/dev/null 2>&1; then
-    echo "[$(date +%F' '%T)] backend healthy -> bench @ LUBM-$WUKONG_BENCH_SCALE" >> "$LOG"
-    timeout 10800 python bench.py >> "$LOG" 2>&1
+    # cycle kernel A/Bs so the partial store accumulates comparison points:
+    # default first (the headline), then merge-off, then stream-off
+    case $((PASS % 3)) in
+      0) AB="" ;;
+      1) AB="WUKONG_ENABLE_MERGE=0" ;;
+      2) AB="WUKONG_ENABLE_STREAM=0" ;;
+    esac
+    echo "[$(date +%F' '%T)] backend healthy -> bench @ LUBM-$WUKONG_BENCH_SCALE ${AB:-default}" >> "$LOG"
+    env $AB timeout 10800 python bench.py >> "$LOG" 2>&1
     echo "[$(date +%F' '%T)] bench pass done (rc=$?)" >> "$LOG"
+    PASS=$((PASS + 1))
     sleep 60
   else
     echo "[$(date +%F' '%T)] backend unreachable" >> "$LOG"

@@ -188,3 +188,31 @@ def test_mxu_and_vpu_compaction_agree():
     for x, y in zip(a, b):
         assert np.array_equal(np.asarray(x), np.asarray(y))
     assert int(a[3]) > 0
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_stream_fuzz_random_shapes(seed):
+    """Randomized shapes: segment sizes, degree skew (hub runs), frontier
+    density, live masks, both compaction backends — all must match the XLA
+    emit exactly."""
+    rng = np.random.default_rng(100 + seed)
+    nkeys = int(rng.integers(8, 600))
+    max_deg = int(rng.integers(1, 30))
+    sk, ss, sd, e, keys, offs = _mk_segment(rng, nkeys=nkeys, max_deg=max_deg)
+    C = int(rng.choice([64, 256, 1024]))
+    n = int(rng.integers(0, min(C, nkeys) + 1))
+    cur = np.full(C, INT32_MAX, np.int32)
+    if n:
+        cur[:n] = rng.choice(keys, size=n, replace=False)
+    live = rng.random(C) > rng.random() * 0.5
+    mxu = bool(rng.integers(0, 2))
+    a = merge_expand(jnp.asarray(sk), jnp.asarray(ss), jnp.asarray(sd),
+                     jnp.asarray(e), jnp.asarray(cur), jnp.int32(n),
+                     jnp.asarray(live), cap_out=1 << 13)
+    b = stream_expand(jnp.asarray(sk), jnp.asarray(ss), jnp.asarray(sd),
+                      jnp.asarray(e), jnp.asarray(cur), jnp.int32(n),
+                      jnp.asarray(live), cap_out=1 << 13, interpret=True,
+                      mxu=mxu)
+    assert int(a[3]) == int(b[3]) and int(a[2]) == int(b[2])
+    assert np.array_equal(np.asarray(a[0]), np.asarray(b[0]))
+    assert np.array_equal(np.asarray(a[1]), np.asarray(b[1]))
