@@ -250,3 +250,39 @@ def test_emulator_heavy_batched_device(proxy, monkeypatch):
     out = Emulator(proxy).run(mix, duration_s=0.5, warmup_s=0.1)
     assert out["thpt_qps"] > 0
     assert calls and all(b >= 1 for b in calls)
+
+
+def test_emulator_templates_q7_to_q12(proxy):
+    """The reference's extended emulator templates: direction terminators
+    (`<-`) and %<fromPredicate> placeholders (proxy.hpp:76-99) must fill
+    and execute. Instantiated constants must come from the right side of
+    the predicate index."""
+    import numpy as np
+
+    from wukong_tpu.sparql.parser import Parser
+
+    rng = np.random.default_rng(0)
+    for qn in ("q7", "q8", "q9", "q10", "q11", "q12"):
+        text = open("/root/reference/scripts/sparql_query/lubm/emulator/"
+                    f"{qn}").read()
+        t = Parser(proxy.str_server).parse_template(text)
+        proxy.fill_template(t)
+        q = t.instantiate(rng)
+        from wukong_tpu.planner.heuristic import heuristic_plan
+
+        heuristic_plan(q)
+        proxy.cpu.execute(q)
+        assert q.result.status_code == 0, qn
+        assert q.result.nrows > 0, qn
+
+    # %<fromPredicate> in an OBJECT slot draws the predicate's objects
+    tq11 = Parser(proxy.str_server).parse_template(
+        open("/root/reference/scripts/sparql_query/lubm/emulator/q11").read())
+    proxy.fill_template(tq11)
+    (pi, fld), = tq11.pos
+    pat = tq11.query.pattern_group.patterns[pi]
+    from wukong_tpu.types import OUT
+
+    objs = set(int(x) for x in proxy.g.get_index(pat.predicate, OUT))
+    assert fld == "object"
+    assert set(int(c) for c in tq11.candidates[0]) <= objs

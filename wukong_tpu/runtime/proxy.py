@@ -18,7 +18,7 @@ from wukong_tpu.planner.plan_file import set_plan
 from wukong_tpu.runtime.monitor import Monitor
 from wukong_tpu.sparql.ir import SPARQLQuery, SPARQLTemplate
 from wukong_tpu.sparql.parser import Parser
-from wukong_tpu.types import IN
+from wukong_tpu.types import IN, OUT, is_tpid
 from wukong_tpu.utils.errors import ErrorCode, WukongError
 from wukong_tpu.utils.logger import log_error, log_info
 from wukong_tpu.utils.timer import get_usec
@@ -145,10 +145,21 @@ class Proxy:
     def fill_template(self, tmpl: SPARQLTemplate) -> None:
         """Collect candidate constants per %placeholder by running the
         type/predicate index (proxy.hpp:69-129)."""
-        from wukong_tpu.types import is_tpid
-
         tmpl.candidates = []
-        for tid in tmpl.ptypes:
+        for tid, (pi, fld) in zip(tmpl.ptypes, tmpl.pos):
+            if tid == "fromPredicate":
+                # %<fromPredicate> (proxy.hpp:76-99): candidates are the
+                # pattern's predicate index — subject slots draw its
+                # subjects (IN side), object slots its objects (OUT side)
+                pat = tmpl.query.pattern_group.patterns[pi]
+                d = IN if fld == "subject" else OUT
+                cands = np.asarray(self.g.get_index(pat.predicate, d))
+                if len(cands) == 0:
+                    raise WukongError(
+                        ErrorCode.UNKNOWN_SUB,
+                        f"no candidates for predicate {pat.predicate}")
+                tmpl.candidates.append(cands)
+                continue
             if not is_tpid(tid):
                 raise WukongError(ErrorCode.SYNTAX_ERROR,
                                   f"placeholder type {tid} is not an index id")

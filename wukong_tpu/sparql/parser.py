@@ -51,9 +51,9 @@ _TOKEN_RE = re.compile(
     (?P<WS>\s+|\#[^\n]*)
   | (?P<IRI><[^<>\s]*>)
   | (?P<VAR>[?$][A-Za-z_][A-Za-z0-9_]*)
-  | (?P<STRING>"(?:[^"\\]|\\.)*"(?:\^\^[^\s.;,)]+)?)
+  | (?P<STRING>"(?:[^"\\]|\\.)*"(?:\^\^[^\s.;,)]+|@[A-Za-z][A-Za-z0-9-]*)?)
   | (?P<NUM>[+-]?\d+(?:\.\d+)?)
-  | (?P<TEMPLATE>%[A-Za-z_][A-Za-z0-9_-]*:[A-Za-z_][A-Za-z0-9_.-]*)
+  | (?P<TEMPLATE>%(?:[A-Za-z_][A-Za-z0-9_-]*:[A-Za-z_][A-Za-z0-9_.-]*|<[^<>\s]*>))
   | (?P<PNAME>[A-Za-z_][A-Za-z0-9_-]*:[A-Za-z_][A-Za-z0-9_.-]*)
   | (?P<KEYWORD>[A-Za-z_][A-Za-z0-9_]*)
   | (?P<OP>&&|\|\||!=|<=|>=|[{}().,;*=<>!+\-/:])
@@ -268,7 +268,21 @@ class Parser:
                     p = self._parse_term(predicate=True)
                 o = self._parse_term()
                 group["patterns"].append((s, p, o))
-            if self._peek()[1] == ".":
+            # reference direction terminators '<-' / '->'
+            # (SPARQLParser.hpp:820-829). They are pure EXECUTION-orientation
+            # hints — '<-' swaps the pattern's endpoints with direction IN,
+            # which matches the same triples — and both our planners
+            # re-derive orientation from bindings, so the hint is accepted
+            # and dropped (the planner-off pre-oriented path is served by
+            # .fmt plan files' <</>> markers instead). Matched as TWO
+            # one-char OP tokens: a '<-' lexer token would break
+            # FILTER(?y<-1), which must stay '<' '-1'.
+            nxt = self._peek()[1]
+            if nxt in ("<", "-") and self.toks[self.i + 1][1] in ("-", ">")                     and (nxt, self.toks[self.i + 1][1]) in (("<", "-"),
+                                                            ("-", ">")):
+                self._next()
+                self._next()
+            elif nxt == ".":
                 self._next()
         return group
 
@@ -282,7 +296,11 @@ class Parser:
         if kind == "PNAME":
             return _Term("iri", self._expand_pname(val))
         if kind == "TEMPLATE":
-            return _Term("template", self._expand_pname(val[1:]))
+            # %prefix:name or %<full-iri> (the watdiv emulator templates use
+            # the full-IRI form)
+            body = val[1:]
+            return _Term("template", body if body.startswith("<")
+                         else self._expand_pname(body))
         if kind == "STRING":
             return _Term("literal", val)
         if kind == "NUM":
@@ -461,7 +479,14 @@ class Parser:
         return pg
 
     def _reserve_template_slot(self, pattern_idx: int, fld: str, t: _Term) -> int:
-        """%type placeholder: record slot, resolve the placeholder's type id."""
+        """%type placeholder: record slot, resolve the placeholder's type id.
+        `%<fromPredicate>` (proxy.hpp:76-99) draws candidates from the
+        pattern's own predicate index instead of a type — recorded as a
+        marker for fill_template, no id to resolve."""
+        if "fromPredicate" in t.value:
+            self.template.ptypes.append("fromPredicate")
+            self.template.pos.append((pattern_idx, fld))
+            return 0
         try:
             tid = self.str_server.str2id(t.value)
         except KeyError:
