@@ -286,3 +286,63 @@ def test_emulator_templates_q7_to_q12(proxy):
     objs = set(int(x) for x in proxy.g.get_index(pat.predicate, OUT))
     assert fld == "object"
     assert set(int(c) for c in tq11.candidates[0]) <= objs
+
+
+def test_engine_pool_failure_detection_and_respawn():
+    """Beyond the reference (wukong.cpp:252 TODO: no supervision at all):
+    an engine THREAD death fails its in-flight query (no stranded waiter),
+    the tid respawns with a fresh engine, and queued work still completes.
+    Past MAX_RESPAWNS the engine is declared dead and routed around."""
+    import threading
+    import time as _time
+
+    from wukong_tpu.runtime.scheduler import EnginePool
+
+    class Bomb:
+        """Engine whose execute kills the whole THREAD on 'die' queries."""
+
+        def __init__(self, tid):
+            self.tid = tid
+
+        def execute(self, q):
+            if q == "die":
+                raise SystemExit(13)  # escapes the per-query except Exception
+            return ("ok", self.tid, q)
+
+    pool = EnginePool(num_engines=2, make_engine=Bomb)
+    pool._neighbors = lambda tid: []  # no stealing: deterministic victim
+    pool.start()
+    try:
+        # normal operation
+        assert pool.wait(pool.submit("a"), timeout=10)[0] == "ok"
+
+        # thread death: the in-flight query FAILS (waiter not stranded)...
+        qid = pool.submit("die", tid=0)
+        out = pool.wait(qid, timeout=10)
+        assert isinstance(out, RuntimeError)
+        # ...and the tid respawned: work routed to it still completes
+        deadline = _time.time() + 10
+        while pool.health()[0]["respawns"] != 1:
+            assert _time.time() < deadline
+            _time.sleep(0.01)
+        assert pool.wait(pool.submit("b", tid=0), timeout=10)[0] == "ok"
+        h = pool.health()
+        # a served query resets the crash budget (decay): isolated poison
+        # queries over time must never accumulate into declare-dead
+        assert h[0]["alive"] and h[0]["respawns"] == 0
+
+        # crash loop: exceed MAX_RESPAWNS -> dead, submissions route around
+        for _ in range(EnginePool.MAX_RESPAWNS + 1):
+            out = pool.wait(pool.submit("die", tid=0), timeout=10)
+            assert isinstance(out, RuntimeError)
+        deadline = _time.time() + 10
+        while pool.health()[0]["alive"]:
+            assert _time.time() < deadline
+            _time.sleep(0.01)
+        # dead engine: new work still completes (on the survivor)
+        for _ in range(4):
+            assert pool.wait(pool.submit("c", tid=0), timeout=10)[0] == "ok"
+        assert pool.health()[1]["alive"]
+        assert threading.active_count() >= 1
+    finally:
+        pool.stop()

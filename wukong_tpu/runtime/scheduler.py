@@ -21,6 +21,12 @@ from wukong_tpu.utils.timer import get_usec
 
 
 class EnginePool:
+    # engine-thread crashes (outside the per-query try) respawn up to this
+    # many times per tid; past it the engine is declared dead, its queue is
+    # redistributed, and routing skips it. The reference has NO failure
+    # handling at all (wukong.cpp:252 TODO; a dead pthread strands its ring).
+    MAX_RESPAWNS = 3
+
     def __init__(self, num_engines: int | None = None, make_engine=None):
         """make_engine(tid) -> object with .execute(query) (one per thread,
         mirroring per-thread SPARQLEngine instances)."""
@@ -28,7 +34,7 @@ class EnginePool:
         self.queues = [collections.deque() for _ in range(self.n)]
         self.locks = [threading.Lock() for _ in range(self.n)]
         self._make_engine = make_engine
-        self._threads: list[threading.Thread] = []
+        self._threads: list[threading.Thread | None] = [None] * self.n
         self._stop = threading.Event()
         self._pending = threading.Semaphore(0)
         self._results: dict[int, object] = {}
@@ -36,22 +42,99 @@ class EnginePool:
         self._next_qid = 0
         self._done = {}
         self._completed = collections.deque()  # finished qids (poll() feed)
+        self._respawns = [0] * self.n
+        self._dead = [False] * self.n
+        # serializes dead-state transitions against routing: submit's
+        # dead-check + enqueue must not interleave with declare-dead's
+        # drain, or a query lands in a queue nobody will ever pop
+        self._route_lock = threading.Lock()
+        self._busy_since = [0] * self.n  # usec; 0 = idle (health() surface)
+        self._inflight: list = [None] * self.n  # (qid, query) being executed
 
     # ------------------------------------------------------------------
     def start(self) -> None:
         for tid in range(self.n):
-            t = threading.Thread(target=self._run_engine, args=(tid,),
-                                 daemon=True, name=f"engine-{tid}")
-            t.start()
-            self._threads.append(t)
+            self._spawn(tid)
+
+    def _spawn(self, tid: int) -> None:
+        t = threading.Thread(target=self._run_engine, args=(tid,),
+                             daemon=True, name=f"engine-{tid}")
+        t.start()
+        self._threads[tid] = t
 
     def stop(self) -> None:
         self._stop.set()
-        for _ in self._threads:
-            self._pending.release()
         for t in self._threads:
-            t.join(timeout=5)
-        self._threads.clear()
+            if t is not None:
+                self._pending.release()
+        for t in self._threads:
+            if t is not None:
+                t.join(timeout=5)
+        self._threads = [None] * self.n
+
+    # ------------------------------------------------------------------
+    # failure detection / recovery (beyond the reference: its engine
+    # pthreads have no supervision — wukong.cpp:245-252)
+    # ------------------------------------------------------------------
+    def health(self) -> dict:
+        """Per-engine liveness snapshot: alive flag, respawn count, and how
+        long the current query has been executing (0 = idle). A stuck
+        engine shows a growing busy_us — report-only (Python threads cannot
+        be preempted safely); dead engines are routed around."""
+        now = get_usec()
+        return {
+            tid: {"alive": not self._dead[tid],
+                  "respawns": self._respawns[tid],
+                  "busy_us": (now - b) if (b := self._busy_since[tid]) else 0}
+            for tid in range(self.n)}
+
+    def _fail(self, qid: int, exc: Exception) -> None:
+        """Deliver an error result, honoring the append-before-set protocol
+        (one place: wait()/poll() race discipline lives here only)."""
+        with self._results_lock:
+            self._results[qid] = exc
+            ev = self._done[qid]
+        self._completed.append(qid)
+        ev.set()
+
+    def _on_engine_death(self, tid: int, exc: BaseException) -> None:
+        from wukong_tpu.utils.logger import log_error, log_warn
+
+        # the in-flight query (if any) likely triggered the crash: fail it
+        # rather than retry it into every engine, and never strand its waiter
+        self._busy_since[tid] = 0
+        item = self._inflight[tid]
+        self._inflight[tid] = None
+        if item is not None:
+            qid, _q = item
+            self._fail(qid, RuntimeError(
+                f"engine-{tid} crashed executing query {qid}: {exc!r}"))
+        self._respawns[tid] += 1
+        if self._respawns[tid] <= self.MAX_RESPAWNS and not self._stop.is_set():
+            log_warn(f"engine-{tid} died ({exc!r}); respawning "
+                     f"({self._respawns[tid]}/{self.MAX_RESPAWNS})")
+            self._spawn(tid)  # its queue is intact; the new thread drains it
+            return
+        # crash loop: declare dead, push queued work to the neighbors so
+        # nothing strands, and stop routing here (submit skips dead tids).
+        # _route_lock makes the drain atomic against concurrent submits and
+        # other deaths — nothing can enqueue into the drained queue after.
+        log_error(f"engine-{tid} dead after {self._respawns[tid]} crashes; "
+                  "redistributing its queue")
+        with self._route_lock:
+            self._dead[tid] = True
+            with self.locks[tid]:
+                stranded = list(self.queues[tid])
+                self.queues[tid].clear()
+            live = [t for t in range(self.n) if not self._dead[t]]
+            for k, item in enumerate(stranded):
+                if not live:  # whole pool dead: fail queries, don't hang
+                    self._fail(item[0], RuntimeError("engine pool dead"))
+                    continue
+                dst = live[k % len(live)]
+                with self.locks[dst]:
+                    self.queues[dst].append(item)
+                self._pending.release()
 
     # ------------------------------------------------------------------
     def submit(self, query, tid: int | None = None) -> int:
@@ -62,8 +145,15 @@ class EnginePool:
             self._next_qid += 1
             self._done[qid] = threading.Event()
         t = qid % self.n if tid is None else tid % self.n
-        with self.locks[t]:
-            self.queues[t].append((qid, query))
+        with self._route_lock:  # atomic dead-check + enqueue vs declare-dead
+            if self._dead[t]:  # route around dead engines
+                live = [k for k in range(self.n) if not self._dead[k]]
+                if not live:
+                    self._fail(qid, RuntimeError("engine pool dead"))
+                    return qid
+                t = live[qid % len(live)]
+            with self.locks[t]:
+                self.queues[t].append((qid, query))
         self._pending.release()
         return qid
 
@@ -119,6 +209,13 @@ class EnginePool:
         return None
 
     def _run_engine(self, tid: int) -> None:
+        try:
+            self._engine_loop(tid)
+        except BaseException as e:  # thread death (not per-query errors)
+            if not self._stop.is_set():
+                self._on_engine_death(tid, e)
+
+    def _engine_loop(self, tid: int) -> None:
         from wukong_tpu.runtime.bind import get_binder
 
         get_binder().bind_thread(tid)  # no-op unless core binding is enabled
@@ -133,10 +230,21 @@ class EnginePool:
                 snooze_us = 10 if got else min(snooze_us * 2, 80)
                 continue
             qid, query = item
+            self._inflight[tid] = item
+            self._busy_since[tid] = get_usec()
             try:
                 out = engine.execute(query)
             except Exception as e:  # engine errors become the reply
                 out = e
+            # cleared HERE, not in a finally: a thread-killing exception
+            # must leave the in-flight marker for _on_engine_death to fail
+            # the query instead of stranding its waiter
+            self._busy_since[tid] = 0
+            self._inflight[tid] = None
+            # a served query proves the engine healthy: reset the crash
+            # budget so isolated poison queries spread over time never
+            # accumulate into a permanent declare-dead
+            self._respawns[tid] = 0
             with self._results_lock:
                 self._results[qid] = out
                 ev = self._done[qid]  # capture: a racing poll() may pop it
