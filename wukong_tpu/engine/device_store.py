@@ -47,6 +47,9 @@ class DeviceSegment:
     num_edges: int
     max_probe: int  # static probe-round bound — part of the jit key
     max_deg_log2: int  # static binary-search depth for membership tests
+    # VERSATILE combined segments carry a second aligned edge array: the
+    # per-edge PREDICATE ids (edges = neighbor values) — expand2 gathers both
+    edges2: object = None
     fpw0: object = None  # jnp int32 [NB] packed lane-0..3 fingerprints
     fpw1: object = None  # jnp int32 [NB] packed lane-4..7 fingerprints
     max_fp_dup: int = 1  # exact max same-fp count within any bucket (static)
@@ -55,6 +58,8 @@ class DeviceSegment:
     def nbytes(self) -> int:
         n = (self.bkey.size + self.bstart.size
              + self.bdeg.size + self.edges.size) * 4
+        if self.edges2 is not None:
+            n += self.edges2.size * 4
         if self.fpw0 is not None:
             n += (self.fpw0.size + self.fpw1.size) * 4
         return n
@@ -228,6 +233,50 @@ class DeviceStore:
             seg = self._stage(host.keys, host.offsets, host.edges)
         if seg is not None:
             self._insert(key, seg)
+        return seg
+
+    def versatile_segment(self, d: int) -> DeviceSegment | None:
+        """Stage the COMBINED adjacency of direction d: one CSR keyed by vid
+        whose edges are every (predicate, neighbor) pair — the device form of
+        the VERSATILE per-vid predicate lists (gstore.hpp:890-903) that the
+        reference only ever walks on the CPU (sparql.hpp:601-650; its GPU
+        engine refuses the shape). Built from the direction's per-predicate
+        segments (vp lists enumerate exactly the predicates with edges);
+        expand2 probes it and binds both the predicate and the neighbor."""
+        self._check_version()
+        key = ("vpv", int(d))
+        if key in self._cache:
+            self._touch(key)
+            return self._cache[key]
+        import jax
+        import jax.numpy as jnp
+
+        parts_v, parts_p, parts_val = [], [], []
+        for (pid, dd), host in sorted(self.g.segments.items()):
+            if int(dd) != int(d) or len(host.edges) == 0:
+                continue
+            degs = (host.offsets[1:] - host.offsets[:-1])
+            parts_v.append(np.repeat(np.asarray(host.keys, np.int64), degs))
+            parts_p.append(np.full(len(host.edges), int(pid), np.int64))
+            parts_val.append(np.asarray(host.edges, np.int64))
+        if not parts_v:
+            return None
+        v = np.concatenate(parts_v)
+        p = np.concatenate(parts_p)
+        w = np.concatenate(parts_val)
+        # stable sort on vid alone: parts were appended pid-ascending, so
+        # stability preserves predicate order within each vid (half the cost
+        # of a two-key lexsort over the whole direction's edge set)
+        order = np.argsort(v, kind="stable")
+        v, p, w = v[order], p[order], w[order]
+        keys, counts = np.unique(v, return_counts=True)
+        offsets = np.concatenate([[0], np.cumsum(counts)])
+        seg = self._stage(keys, offsets, w)
+        Ep = seg.edges.shape[0]
+        p_pad = np.full(Ep, INT32_MAX, dtype=np.int32)
+        p_pad[: len(p)] = p
+        seg.edges2 = jax.device_put(jnp.asarray(p_pad), self.device)
+        self._insert(key, seg)
         return seg
 
     def index_list(self, tpid: int, d: int):
@@ -453,3 +502,7 @@ class DeviceStore:
         for p in patterns:
             if p.predicate >= 0:
                 self.segment(p.predicate, p.direction)
+            else:
+                # versatile steps use the combined segment — the LARGEST
+                # staging in the chain, exactly what prefetch exists for
+                self.versatile_segment(p.direction)

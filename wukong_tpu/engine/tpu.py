@@ -7,11 +7,12 @@ jitted kernels in tpu_kernels.py against segments staged by DeviceStore, and the
 result is copied host-side only at the end (D2H only on the last pattern,
 gpu_engine_cuda.hpp:189-196).
 
-Scope mirrors the reference's accelerator support matrix
-(gpu_engine.hpp:267-333): index/const starts and known_to_unknown/known/const
-run on device; VERSATILE (unknown predicate), attribute patterns, OPTIONAL, and
-UNION fall back to the CPU oracle kernels via a host sync — the reference
-instead refuses such queries on GPU; we degrade gracefully.
+Scope EXCEEDS the reference's accelerator support matrix
+(gpu_engine.hpp:267-333): index/const starts, known_to_unknown/known/const,
+and the VERSATILE known_unknown_unknown shape (combined-adjacency segment +
+expand2 — the reference refuses every versatile shape on GPU) run on device;
+other versatile shapes, attribute patterns, OPTIONAL, and UNION fall back to
+the CPU oracle kernels via a host sync — graceful degradation, not refusal.
 
 Execution discipline (measured on the axon-tunneled chip): a host<->device sync
 costs ~70 ms regardless of payload, while dispatches pipeline asynchronously at
@@ -139,6 +140,10 @@ class TPUEngine:
             pins = [(q.get_pattern(i).predicate, q.get_pattern(i).direction)
                     for i in range(q.pattern_step, q.pattern_step + device_steps)
                     if q.get_pattern(i).predicate > 0]
+            pins += [("vpv", int(q.get_pattern(i).direction))
+                     for i in range(q.pattern_step,
+                                    q.pattern_step + device_steps)
+                     if q.get_pattern(i).predicate < 0]
             self.dstore.pin(pins)
             if Global.gpu_enable_pipeline:
                 # stage every chain segment up front: device_put dispatches
@@ -254,6 +259,27 @@ class TPUEngine:
 
         col = anchor_col if anchor_col is not None else state.col_of(start)
         assert_ec(col is not None, ErrorCode.VERTEX_INVALID)
+        if pid < 0:  # versatile known_unknown_unknown via expand2
+            vseg = self.dstore.versatile_segment(d)
+            if vseg is None:
+                state.append_empty_col(pid)
+                state.append_empty_col(end)
+                return
+            fan = max(1.0, vseg.num_edges / max(vseg.num_keys, 1)) * 2
+            est = min(int(state.est_rows * fan) or 1, self.cap_max)
+            cap_out = cap_override.get(step) or K.next_capacity(
+                max(est, self.cap_min), self.cap_min, self.cap_max)
+            up = K.want_pallas(vseg.bkey, state.table.shape[1])
+            fd = self._fp_dup(vseg, up)
+            out, nn, total = K.expand2(
+                state.table, state.n, vseg.bkey, vseg.bstart, vseg.bdeg,
+                vseg.edges2, vseg.edges, col=col, cap_out=cap_out,
+                max_probe=vseg.max_probe, use_pallas=up,
+                fpw0=vseg.fpw0 if fd else None,
+                fpw1=vseg.fpw1 if fd else None, fp_dup=fd)
+            state.advance_expand2(out, nn, pid, end, total, cap_out, step,
+                                  est_rows=min(est, cap_out))
+            return
         seg = self.dstore.segment(pid, d)
         e_col = state.col_of(end) if end < 0 else None
         e_known = end < 0 and e_col is not None
@@ -541,7 +567,18 @@ class TPUEngine:
         if pat.pred_type != int(AttrType.SID_t):
             return False
         if pat.predicate < 0:
-            return False  # versatile -> host
+            # VERSATILE: the known_unknown_unknown shape (?x ?p ?y, x bound,
+            # p and y fresh vars) runs on device via the combined-adjacency
+            # segment + expand2 (beyond the reference, whose GPU engine
+            # refuses every versatile shape — gpu_engine.hpp:267-333).
+            # Other versatile shapes (const anchors, bound objects) stay
+            # on the host path.
+            return (Global.enable_versatile
+                    and pat.subject < 0
+                    and probe.col_of(pat.subject) is not None
+                    and probe.col_of(pat.predicate) is None
+                    and pat.object < 0
+                    and probe.col_of(pat.object) is None)
         if is_first and q.pattern_step == 0 and q.start_from_index():
             # index_to_known is host-only (like the reference GPU engine)
             return probe.col_of(pat.object) is None
@@ -578,6 +615,11 @@ class _MetaResult:
         if self.width == 0:
             self.cols[pat.object], self.width = 0, 1
             return
+        if pat.predicate < 0 and self.col_of(pat.predicate) is None:
+            # versatile expand2 binds the predicate var first (pid column
+            # precedes the value column, matching the CPU kernel's order)
+            self.cols[pat.predicate] = self.width
+            self.width += 1
         if pat.object < 0 and self.col_of(pat.object) is None:
             self.cols[pat.object] = self.width
             self.width += 1
@@ -616,6 +658,18 @@ class _ChainState:
         self.cols[end_var] = self.width
         self.new_cols.append((end_var, self.width))
         self.width += 1
+        self.totals.append((step, total, cap))
+        self.est_rows = max(est_rows, 1)
+
+    def advance_expand2(self, table, n, pred_var: int, end_var: int, total,
+                        cap: int, step: int, est_rows: int) -> None:
+        """Versatile expand: binds the predicate column then the value."""
+        self.table = table
+        self.n = n
+        for var in (pred_var, end_var):
+            self.cols[var] = self.width
+            self.new_cols.append((var, self.width))
+            self.width += 1
         self.totals.append((step, total, cap))
         self.est_rows = max(est_rows, 1)
 

@@ -342,6 +342,43 @@ def expand(table, n, bkey, bstart, bdeg, edges, col, cap_out, max_probe,
 
 
 @partial(jax.jit,
+         static_argnames=("col", "cap_out", "max_probe", "use_pallas",
+                          "fp_dup"))
+def expand2(table, n, bkey, bstart, bdeg, edges_pid, edges_val, col, cap_out,
+            max_probe, use_pallas=False, fpw0=None, fpw1=None, fp_dup=0):
+    """VERSATILE known_unknown_unknown (?x ?p ?y with x bound — the
+    reference's sparql.hpp:601-650 kernel; its GPU engine refuses the
+    shape): expand each live row by its COMBINED adjacency — every
+    (predicate, neighbor) pair — binding TWO new columns. Identical
+    machinery to expand(), one extra aligned-edge-array gather.
+
+    Returns (out [W+2, cap_out] with pid then val rows, out_n, total)."""
+    W, C = table.shape
+    rows = jnp.arange(C, dtype=jnp.int32)
+    cur = table[col]
+    found, start, deg = _probe(bkey, bstart, bdeg, cur, n, max_probe,
+                               use_pallas, fpw0, fpw1, fp_dup)
+    cum = jnp.cumsum(deg)
+    total = _saturate_total(cum)
+    starts_excl = cum - deg
+    park = jnp.where(deg > 0, starts_excl, cap_out)
+    marks = jnp.zeros(cap_out, dtype=jnp.int32).at[park].max(
+        rows + 1, mode="drop")
+    src = jax.lax.cummax(marks) - 1
+    srcc = jnp.clip(src, 0, C - 1)
+    j = jnp.arange(cap_out, dtype=jnp.int32)
+    eidx = jnp.clip(start[srcc] + (j - starts_excl[srcc]), 0,
+                    edges_val.shape[0] - 1)
+    pid = edges_pid[eidx]
+    val = edges_val[eidx]
+    out_valid = (j < total) & (src >= 0)
+    out = jnp.concatenate([table[:, srcc], pid[None, :], val[None, :]],
+                          axis=0)
+    out = jnp.where(out_valid[None, :], out, 0)
+    return out, jnp.minimum(total, cap_out).astype(jnp.int32), total
+
+
+@partial(jax.jit,
          static_argnames=("col", "max_probe", "depth", "use_pallas",
                           "fp_dup"))
 def member_mask_known(table, n, vals, bkey, bstart, bdeg, edges,
