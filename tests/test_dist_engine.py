@@ -197,6 +197,7 @@ OPTIONAL_DIR = "/root/reference/scripts/sparql_query/lubm/optional"
 UNION_DIR = "/root/reference/scripts/sparql_query/lubm/union"
 ATTR_DIR = "/root/reference/scripts/sparql_query/lubm/attr"
 UB = "http://swat.cse.lehigh.edu/onto/univ-bench.owl#"
+RDF = "http://www.w3.org/1999/02/22-rdf-syntax-ns#"
 
 
 def _rows_of(res):
@@ -424,3 +425,58 @@ def test_preshard_multihost_load_matches_global(tmp_path, eight_cpu_devices):
     cpu.execute(qc)
     assert qd.result.status_code == 0
     assert _rows_of(qd.result) == _rows_of(qc.result)
+
+
+def test_dist_versatile_kuu(world):
+    """Distributed VERSATILE ?x ?p ?y (x bound): each shard expands its
+    combined adjacency inside the compiled chain — beyond the reference,
+    whose accelerator refuses every versatile shape. Exact row parity with
+    the single-host CPU kernels, including a continuation step."""
+    _compare(world, f"""PREFIX ub: <{UB}>
+    SELECT ?X ?P ?Y WHERE {{
+        ?X ub:worksFor <http://www.Department0.University0.edu> .
+        ?X ?P ?Y .
+    }}""")
+    # continuation anchored on the versatile VALUE column
+    _compare(world, f"""PREFIX rdf: <{RDF}>
+    PREFIX ub: <{UB}>
+    SELECT ?X ?P ?Y WHERE {{
+        ?X ub:worksFor <http://www.Department0.University0.edu> .
+        ?X ?P ?Y .
+        ?Y rdf:type ub:Course .
+    }}""")
+
+
+def test_dist_versatile_probe_bound(eight_cpu_devices):
+    """The compiled versatile step must bake the COMBINED segment's probe
+    bound, not a missing segment(pid=0)'s default of 1 — on this world the
+    versatile hash table needs 3 probe rounds, so a baked max_probe=1
+    silently drops every key outside its home bucket (a real bug once)."""
+    from wukong_tpu.loader.generic_rdf import generate_generic
+    from wukong_tpu.sparql.ir import Pattern, SPARQLQuery
+    from wukong_tpu.types import OUT, TYPE_ID
+
+    triples, meta = generate_generic(20_000, n_preds=8, n_types=4, seed=5)
+    stores = build_all_partitions(triples, 8)
+    dist = DistEngine(stores, None, make_mesh(8))
+    assert dist.sstore.versatile_segment(int(OUT)).max_probe > 1
+
+    pids = [int(p) for p in np.unique(triples[:, 1]) if p != TYPE_ID][:1]
+
+    def mk():
+        q = SPARQLQuery()
+        q.pattern_group.patterns = [
+            Pattern(pids[0], 0, 0, -1),   # __PREDICATE__ index start
+            Pattern(-1, -2, OUT, -3)]     # versatile ?x ?p ?y
+        q.result.nvars = 3
+        q.result.required_vars = [-1, -2, -3]
+        return q
+
+    qd = mk()
+    dist.execute(qd, from_proxy=False)
+    assert qd.result.status_code == 0
+    cpu = CPUEngine(build_partition(triples, 0, 1), None)
+    qc = mk()
+    cpu.execute(qc, from_proxy=False)
+    assert _rows_of(qd.result) == _rows_of(qc.result)
+    assert qc.result.nrows > 0

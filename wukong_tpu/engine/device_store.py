@@ -96,6 +96,34 @@ def fp_words(bkey_2d: np.ndarray):
     return w0.view(np.int32), w1.view(np.int32), dup
 
 
+def combined_adjacency(g, d: int):
+    """(keys, offsets, vals, pids) of one partition's COMBINED adjacency in
+    direction d: every (predicate, neighbor) edge keyed by vid, predicate-
+    ordered within each vid (stable sort; per-predicate parts are appended
+    pid-ascending). OUT includes rdf:type edges, IN excludes — matching the
+    host vp-list semantics (gstore.py). Shared by the single-chip and
+    sharded VERSATILE stagings."""
+    parts_v, parts_p, parts_w = [], [], []
+    for (pid, dd), host in sorted(g.segments.items()):
+        if int(dd) != int(d) or len(host.edges) == 0:
+            continue
+        degs = host.offsets[1:] - host.offsets[:-1]
+        parts_v.append(np.repeat(np.asarray(host.keys, np.int64), degs))
+        parts_p.append(np.full(len(host.edges), int(pid), np.int64))
+        parts_w.append(np.asarray(host.edges, np.int64))
+    if not parts_v:
+        return (np.empty(0, np.int64), np.zeros(1, np.int64),
+                np.empty(0, np.int64), np.empty(0, np.int64))
+    v = np.concatenate(parts_v)
+    p = np.concatenate(parts_p)
+    w = np.concatenate(parts_w)
+    order = np.argsort(v, kind="stable")
+    v, p, w = v[order], p[order], w[order]
+    keys, counts = np.unique(v, return_counts=True)
+    offsets = np.concatenate([[0], np.cumsum(counts)])
+    return keys, offsets, w, p
+
+
 def type_index_csr(g):
     """(keys, offsets, edges) of a partition's type index as one CSR keyed by
     type id — shared by the single-chip and sharded stores."""
@@ -251,26 +279,9 @@ class DeviceStore:
         import jax
         import jax.numpy as jnp
 
-        parts_v, parts_p, parts_val = [], [], []
-        for (pid, dd), host in sorted(self.g.segments.items()):
-            if int(dd) != int(d) or len(host.edges) == 0:
-                continue
-            degs = (host.offsets[1:] - host.offsets[:-1])
-            parts_v.append(np.repeat(np.asarray(host.keys, np.int64), degs))
-            parts_p.append(np.full(len(host.edges), int(pid), np.int64))
-            parts_val.append(np.asarray(host.edges, np.int64))
-        if not parts_v:
+        keys, offsets, w, p = combined_adjacency(self.g, d)
+        if len(keys) == 0:
             return None
-        v = np.concatenate(parts_v)
-        p = np.concatenate(parts_p)
-        w = np.concatenate(parts_val)
-        # stable sort on vid alone: parts were appended pid-ascending, so
-        # stability preserves predicate order within each vid (half the cost
-        # of a two-key lexsort over the whole direction's edge set)
-        order = np.argsort(v, kind="stable")
-        v, p, w = v[order], p[order], w[order]
-        keys, counts = np.unique(v, return_counts=True)
-        offsets = np.concatenate([[0], np.cumsum(counts)])
         seg = self._stage(keys, offsets, w)
         Ep = seg.edges.shape[0]
         p_pad = np.full(Ep, INT32_MAX, dtype=np.int32)

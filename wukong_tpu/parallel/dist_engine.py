@@ -36,7 +36,7 @@ from wukong_tpu.utils.errors import ErrorCode, WukongError, assert_ec
 @dataclass
 class _Step:
     kind: str  # init_index | init_const | init_rows | expand
-    #           | expand_type_all | member
+    #           | expand_type_all | expand_versatile | member
     pid: int = 0
     dir: int = 0
     col: int = -1  # anchor column
@@ -412,10 +412,40 @@ class DistEngine:
         for pat in patterns:
             i = len(plan.steps)  # step index (seeded chains prepend init_rows)
             s, p, d, o = pat.subject, pat.predicate, pat.direction, pat.object
-            assert_ec(pat.pred_type == int(AttrType.SID_t) and p >= 0,
+            assert_ec(pat.pred_type == int(AttrType.SID_t),
                       ErrorCode.UNSUPPORTED_SHAPE,
-                      "attr/versatile patterns are host-side in the "
-                      "distributed engine")
+                      "attr patterns are host-side in the distributed engine")
+            if p < 0:
+                # VERSATILE known_unknown_unknown (?x ?p ?y, x bound): each
+                # shard expands against its combined adjacency (beyond the
+                # reference — its accelerator refuses every versatile shape).
+                # Other versatile shapes stay host-side.
+                col = v2c.get(s, NO_RESULT) if s < 0 else NO_RESULT
+                assert_ec(width > 0 and col != NO_RESULT
+                          and p not in v2c and o < 0 and o not in v2c,
+                          ErrorCode.UNSUPPORTED_SHAPE,
+                          "distributed versatile supports ?x ?p ?y with "
+                          "x bound and p, y fresh")
+                exch_cap = 0
+                if aligned_col != col:
+                    exch_cap = exch_cap_for(i, col)
+                vseg = self.sstore.versatile_segment(d)
+                avg = vseg.avg_deg if vseg else 0.0
+                est_rows = int(max(est_rows * max(avg, 0.1) * 2, 1))
+                plan.steps.append(_Step(
+                    kind="expand_versatile", pid=0, dir=d, col=col,
+                    cap=min(cap_for(i, est_rows), self.cap_max),
+                    exch_cap=exch_cap, new_col=True))
+                fwd_max = vseg.max_deg if vseg else 1
+                for c in list(col_mult):
+                    col_mult[c] = min(col_mult[c] * fwd_max, MULT_CAP)
+                # the two fresh columns' multiplicity bounds are unknown
+                # (reverse combined degrees aren't tracked) — leave untracked
+                v2c[p] = width
+                v2c[o] = width + 1
+                width += 2
+                aligned_col = col
+                continue
             if i == 0 and seed is None and q.pattern_step == 0 \
                     and pat is patterns[0] and q.start_from_index():
                 idx = self.sstore.index_list(s, d)
@@ -528,6 +558,15 @@ class DistEngine:
                 idx = self.sstore.index_list(s.pid, s.dir)
                 args.append((idx.edges, self._real_lens_arr(idx)))
                 bounds.append((0, 0))
+            elif s.kind == "expand_versatile":
+                vseg = self.sstore.versatile_segment(s.dir)
+                if vseg is None:
+                    args.append(None)
+                    bounds.append((0, 0))
+                else:
+                    args.append((vseg.bkey, vseg.bstart, vseg.bdeg,
+                                 vseg.edges, vseg.edges2))
+                    bounds.append((vseg.max_probe, vseg.max_deg_log2))
             else:
                 seg = self.sstore.segment(s.pid, s.dir)
                 if seg is None:
@@ -611,7 +650,14 @@ class DistEngine:
         probes = {}
         depths = {}
         for i, s in enumerate(steps):
-            if s.kind not in ("init_index", "init_rows", "member_index"):
+            if s.kind == "expand_versatile":
+                # the combined segment's OWN probe bound — segment(pid=0)
+                # would resolve to nothing and silently bake max_probe=1,
+                # truncating probes on any hash-skewed versatile table
+                vseg = self.sstore.versatile_segment(s.dir)
+                probes[i] = vseg.max_probe if vseg else 1
+                depths[i] = vseg.max_deg_log2 if vseg else 1
+            elif s.kind not in ("init_index", "init_rows", "member_index"):
                 seg = self.sstore.segment(s.pid, s.dir)
                 probes[i] = seg.max_probe if seg else 1
                 depths[i] = seg.max_deg_log2 if seg else 1
@@ -672,7 +718,20 @@ class DistEngine:
                     continue
 
                 arrs = per_step[i]
-                if s.kind in ("expand", "expand_type_all"):
+                if s.kind == "expand_versatile":
+                    if arrs is None:
+                        table = jnp.concatenate(
+                            [table,
+                             jnp.zeros((2, table.shape[1]), jnp.int32)],
+                            axis=0)
+                        n = jnp.int32(0)
+                        continue
+                    bkey, bstart, bdeg, edges, edges2 = arrs
+                    table, n, tot = K.expand2.__wrapped__(
+                        table, n, bkey, bstart, bdeg, edges2, edges,
+                        col=s.col, cap_out=s.cap, max_probe=probes[i])
+                    totals[i] = jnp.maximum(totals[i], tot)
+                elif s.kind in ("expand", "expand_type_all"):
                     if s.kind == "expand_type_all":
                         table, n = _allgather_rows(table, n, D, axis)
                     if arrs is None:

@@ -30,11 +30,16 @@ class StackedSegment:
     max_deg_log2: int
     avg_deg: float  # global average degree (capacity estimation)
     max_deg: int = 1  # global max degree (skew-aware exchange capacities)
+    # VERSATILE combined segments: aligned per-edge predicate ids [D, E_pad]
+    edges2: object = None
 
     @property
     def nbytes(self) -> int:
-        return (self.bkey.size + self.bstart.size + self.bdeg.size
-                + self.edges.size) * 4
+        n = (self.bkey.size + self.bstart.size + self.bdeg.size
+             + self.edges.size) * 4
+        if self.edges2 is not None:
+            n += self.edges2.size * 4
+        return n
 
 
 @dataclass
@@ -142,6 +147,62 @@ class ShardedDeviceStore:
         from wukong_tpu.engine.device_store import type_index_csr
 
         return type_index_csr(g)
+
+    def versatile_segment(self, d: int) -> StackedSegment | None:
+        """Per-shard COMBINED adjacency of direction d, stacked over the
+        mesh: every (predicate, neighbor) pair keyed by vid (the device form
+        of the VERSATILE vp lists — see DeviceStore.versatile_segment). The
+        distributed expand_versatile step probes it and binds both the
+        predicate and the neighbor column; the reference never accelerates
+        any versatile shape (gpu_engine.hpp:267-333)."""
+        self.check_version()
+        key = ("vpv", int(d))
+        if key in self._cache:
+            return self._cache[key]
+        from wukong_tpu.engine.device_store import combined_adjacency
+
+        shards = [combined_adjacency(g, d) for g in self.stores]
+        if all(len(k) == 0 for (k, _, _, _) in shards):
+            self._cache[key] = None
+            return None
+        max_k = max(len(k) for (k, _, _, _) in shards)
+        NB = max(_next_pow2((max_k + 3) // 4), 2)
+        Ep = _next_pow2(max(max(len(e) for (_, _, e, _) in shards), 1))
+        bkeys, bstarts, bdegs, edges_l, pids_l = [], [], [], [], []
+        max_probe = 1
+        max_deg = 1
+        tot_e = tot_k = 0
+        for (k, o, e, p) in shards:
+            bk, bs, bd, mp = build_hash_table(np.asarray(k), np.asarray(o),
+                                              num_buckets=NB)
+            bkeys.append(bk.reshape(-1))
+            bstarts.append(bs.reshape(-1))
+            bdegs.append(bd.reshape(-1))
+            max_probe = max(max_probe, mp)
+            if len(k):
+                max_deg = max(max_deg, int((o[1:] - o[:-1]).max()))
+            tot_e += len(e)
+            tot_k += len(k)
+            ee = np.full(Ep, INT32_MAX, dtype=np.int32)
+            ee[: len(e)] = e
+            edges_l.append(ee)
+            pp = np.full(Ep, INT32_MAX, dtype=np.int32)
+            pp[: len(p)] = p
+            pids_l.append(pp)
+        seg = StackedSegment(
+            bkey=self._put(np.stack(bkeys)),
+            bstart=self._put(np.stack(bstarts)),
+            bdeg=self._put(np.stack(bdegs)),
+            edges=self._put(np.stack(edges_l)),
+            edges2=self._put(np.stack(pids_l)),
+            max_probe=max_probe,
+            max_deg_log2=max(int(max_deg).bit_length(), 1),
+            avg_deg=tot_e / max(tot_k, 1),
+            max_deg=int(max_deg),
+        )
+        self._cache[key] = seg
+        self.bytes_used += seg.nbytes
+        return seg
 
     def host_max_deg(self, pid: int, d: int) -> int:
         """Global max degree of (pid, d) from host CSR metadata — no device
