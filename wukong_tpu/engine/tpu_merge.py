@@ -230,11 +230,9 @@ class MergeExecutor:
                 cap_override = dict(self._cap_memo.get(memo_key, {}))
                 state = _MergeState()
                 self._init_const(state, pats, consts)
-                for k in range(len(pats)):
-                    if k in folds.get("skip", ()):
-                        continue
-                    self._dispatch(q, pats[k], k, state, cap_override, {},
-                                   folds.get(k))
+                for k, pat, _kind, fold in self.classify(
+                        pats, folds, index_mode=False):
+                    self._dispatch(q, pat, k, state, cap_override, {}, fold)
                 counts = K.qid_counts_pos0(state.pos0(), state.n,
                                            state.live_mask(), B=B, r=1,
                                            slice_mode=False)
@@ -282,11 +280,11 @@ class MergeExecutor:
             for _attempt in range(8):
                 state = _MergeState()
                 first = init(state)
-                for k in range(first, len(pats)):
-                    if k in folds.get("skip", ()):
-                        continue
-                    self._dispatch(q, pats[k], k, state, cap_override,
-                                   step_est, folds.get(k))
+                assert first == (1 if mode != "const" else 0)
+                for k, pat, _kind, fold in self.classify(
+                        pats, folds, index_mode=(mode != "const")):
+                    self._dispatch(q, pat, k, state, cap_override,
+                                   step_est, fold)
                 counts = K.qid_counts_pos0(state.pos0(), state.n,
                                            state.live_mask(), B=B, r=r,
                                            slice_mode=slice_mode)
@@ -317,13 +315,44 @@ class MergeExecutor:
             eng.dstore.unpin(pins)
 
     @staticmethod
-    def _chain_pins(pats, folds, index_mode: bool) -> list:
+    def classify(pats, folds, index_mode: bool):
+        """THE single classification of a planned chain's executable steps:
+        yields (step, pat, kind, fold) for every non-folded step, kind in
+        {"expand", "k2k", "k2c"}, walking the bound set exactly the way the
+        executor binds it. Pins and both dispatch loops derive from this one
+        walk — the three hand-maintained copies it replaces could silently
+        drift (advisor r2 #2's root cause)."""
+        if not pats:
+            return
+        vars_bound = {pats[0].object if index_mode else pats[0].subject}
+        # index mode: init consumes pattern 0; const mode: step 0 runs as a
+        # real expand below
+        first = 1 if index_mode else 0
+        skip = folds.get("skip", ())
+        for k in range(first, len(pats)):
+            pat = pats[k]
+            end = pat.object
+            if k in skip:
+                # _plan_folds only folds k2c steps (const objects); a folded
+                # var-object step would silently diverge from the executor's
+                # binding order — fail loudly if that invariant ever breaks
+                assert end > 0, "folded step must be a k2c (const object)"
+                continue
+            if end < 0 and end not in vars_bound:
+                vars_bound.add(end)
+                yield k, pat, "expand", folds.get(k)
+            elif end < 0:
+                yield k, pat, "k2k", None
+            else:
+                yield k, pat, "k2c", None
+
+    @classmethod
+    def _chain_pins(cls, pats, folds, index_mode: bool) -> list:
         """The EXACT DeviceStore keys the planned chain will stage, so pins
         protect what actually runs: folded expands use ("mrgf", pid, d, fkey)
         filtered segments and k2c membership uses ("rev", ...) const lists —
         pinning only ("mrg", ...) left those evictable under budget pressure,
-        forcing a host rebuild + device_put on every call (advisor r2 #2).
-        Mirrors _dispatch's step classification."""
+        forcing a host rebuild + device_put on every call (advisor r2 #2)."""
         pins = []
         seen = set()
 
@@ -332,29 +361,19 @@ class MergeExecutor:
                 seen.add(key)
                 pins.append(key)
 
-        if not pats:
-            return pins
-        vars_bound = {pats[0].object if index_mode else pats[0].subject}
-        first = 1 if index_mode else 0
-        skip = folds.get("skip", ())
-        for k in range(first, len(pats)):
-            if k in skip:
-                continue
-            pat = pats[k]
-            pid, d, end = pat.predicate, int(pat.direction), pat.object
-            if end < 0 and end not in vars_bound:  # expand
-                fold = folds.get(k)
+        for _k, pat, kind, fold in cls.classify(pats, folds, index_mode):
+            pid, d, end = int(pat.predicate), int(pat.direction), pat.object
+            if kind == "expand":
                 if fold is not None:
                     fkey = tuple(sorted((int(p), int(dd), int(c))
                                         for (p, dd, c) in fold[0]))
-                    add(("mrgf", int(pid), d, fkey))
+                    add(("mrgf", pid, d, fkey))
                 else:
-                    add(("mrg", int(pid), d))
-                vars_bound.add(end)
-            elif end < 0:  # known_to_known pair membership
-                add(("mrg", int(pid), d))
-            else:  # known_to_const membership list
-                add(("rev", int(pid), d, int(end)))
+                    add(("mrg", pid, d))
+            elif kind == "k2k":
+                add(("mrg", pid, d))
+            else:
+                add(("rev", pid, d, int(end)))
         return pins
 
     @staticmethod
