@@ -480,3 +480,69 @@ def test_dist_versatile_probe_bound(eight_cpu_devices):
     cpu.execute(qc, from_proxy=False)
     assert _rows_of(qd.result) == _rows_of(qc.result)
     assert qc.result.nrows > 0
+
+
+def test_dist_c2k_mid_chain(world):
+    """const_to_known mid-chain (sparql.hpp:138-163's c2k): a const-subject
+    pattern whose object is already bound runs as a reverse-segment member
+    step inside the compiled chain (patterns built in index form so the
+    c2k stays mid-chain — heuristic_plan would hoist the const start)."""
+    from wukong_tpu.sparql.ir import Pattern, SPARQLQuery
+    from wukong_tpu.types import IN, OUT, TYPE_ID
+
+    ss, cpu, dist = world
+    fp = ss.str2id("<http://swat.cse.lehigh.edu/onto/univ-bench.owl#FullProfessor>")
+    works = ss.str2id("<http://swat.cse.lehigh.edu/onto/univ-bench.owl#worksFor>")
+    fp0 = ss.str2id("<http://www.Department0.University0.edu/FullProfessor0>")
+
+    def mk():
+        q = SPARQLQuery()
+        q.pattern_group.patterns = [
+            Pattern(fp, TYPE_ID, IN, -1),    # type-index start -> ?X
+            Pattern(-1, works, OUT, -2),     # ?X worksFor ?D
+            Pattern(fp0, works, OUT, -2),    # c2k: FP0 worksFor ?D (bound)
+        ]
+        q.result.nvars = 2
+        q.result.required_vars = [-1, -2]
+        return q
+
+    qc, qd = mk(), mk()
+    cpu.execute(qc, from_proxy=False)
+    dist.execute(qd, from_proxy=False)
+    assert qd.result.status_code == 0
+    assert _rows_of(qd.result) == _rows_of(qc.result)
+    assert qc.result.nrows > 0  # FullProfessors of Department0.University0
+
+
+def test_dist_seeded_union_c2k_branch(world):
+    """UNION branches whose FIRST pattern is const-subject/bound-object run
+    distributed off the seeded parent rows (widened seed-anchor resolution)."""
+    from wukong_tpu.sparql.ir import Pattern, PatternGroup, SPARQLQuery
+    from wukong_tpu.types import IN, OUT, TYPE_ID
+
+    ss, cpu, dist = world
+    ap = ss.str2id("<http://swat.cse.lehigh.edu/onto/univ-bench.owl#AssociateProfessor>")
+    works = ss.str2id("<http://swat.cse.lehigh.edu/onto/univ-bench.owl#worksFor>")
+    fp0 = ss.str2id("<http://www.Department0.University0.edu/FullProfessor0>")
+    fp1 = ss.str2id("<http://www.Department1.University0.edu/FullProfessor0>")
+
+    def mk():
+        q = SPARQLQuery()
+        q.pattern_group.patterns = [
+            Pattern(ap, TYPE_ID, IN, -1),
+            Pattern(-1, works, OUT, -2),
+        ]
+        for c in (fp0, fp1):
+            u = PatternGroup()
+            u.patterns = [Pattern(c, works, OUT, -2)]  # seeded c2k branch
+            q.pattern_group.unions.append(u)
+        q.result.nvars = 2
+        q.result.required_vars = [-1, -2]
+        return q
+
+    qc, qd = mk(), mk()
+    cpu.execute(qc, from_proxy=False)
+    dist.execute(qd, from_proxy=False)
+    assert qd.result.status_code == 0
+    assert _rows_of(qd.result) == _rows_of(qc.result)
+    assert qc.result.nrows > 0

@@ -15,6 +15,9 @@ from wukong_tpu.store.gstore import build_all_partitions, build_partition
 from wukong_tpu.types import IN, OUT, TYPE_ID
 
 
+_fuzz_dist_cache: dict = {}
+
+
 @pytest.fixture(scope="module")
 def world():
     triples, meta = generate_generic(20_000, n_preds=80, n_types=20, seed=5)
@@ -87,7 +90,7 @@ def _mk_query(hub, pid):
 
 
 @pytest.mark.parametrize("seed", range(6))
-def test_fuzz_random_bgps_all_engines(world, seed):
+def test_fuzz_random_bgps_all_engines(world, seed, eight_cpu_devices):
     """Differential fuzz: random BGP shapes (chains, stars, const anchors,
     k2k/k2c closures, type filters) planned by the type-centric Planner and
     executed by CPU and TPU engines — both must match the independent
@@ -99,6 +102,13 @@ def test_fuzz_random_bgps_all_engines(world, seed):
     planner = Planner(stats)
     cpu = CPUEngine(g, None)
     tpu = TPUEngine(g, None, stats=stats)
+    from wukong_tpu.parallel.dist_engine import DistEngine
+    from wukong_tpu.parallel.mesh import make_mesh
+
+    if "dist" not in _fuzz_dist_cache:
+        _fuzz_dist_cache["dist"] = DistEngine(
+            build_all_partitions(triples, 8), None, make_mesh(8))
+    dist = _fuzz_dist_cache["dist"]
     pids = [int(p) for p in np.unique(triples[:, 1]) if p != TYPE_ID]
     norm = triples[triples[:, 1] != TYPE_ID]
     typed = triples[triples[:, 1] == TYPE_ID]
@@ -148,8 +158,11 @@ def test_fuzz_random_bgps_all_engines(world, seed):
             q.result.required_vars = list(req)
             return q
 
+        engines = [("cpu", cpu), ("tpu", tpu)]
+        if raw[0][0] > 0:  # const-anchored: dist-plannable shape
+            engines.append(("dist", dist))
         outs = {}
-        for name, eng in (("cpu", cpu), ("tpu", tpu)):
+        for name, eng in engines:
             q = mk()
             assert planner.generate_plan(q)
             eng.execute(q)
@@ -157,5 +170,5 @@ def test_fuzz_random_bgps_all_engines(world, seed):
             cols = [q.result.var2col(v) for v in req]
             outs[name] = sorted(
                 map(tuple, np.asarray(q.result.table)[:, cols].tolist()))
-        assert outs["cpu"] == want, f"cpu diverged on {raw}"
-        assert outs["tpu"] == want, f"tpu diverged on {raw}"
+        for name, rows in outs.items():
+            assert rows == want, f"{name} diverged on {raw}"

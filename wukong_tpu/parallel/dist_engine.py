@@ -396,10 +396,9 @@ class DistEngine:
             first = patterns[0]
             if first.subject < 0:
                 anchor = v2c.get(first.subject, NO_RESULT)
-            elif _is_index_pattern(first):  # index membership on a bound col
-                anchor = v2c.get(first.object, NO_RESULT)
             else:
-                anchor = NO_RESULT
+                # index membership or c2k on a bound (seeded) object column
+                anchor = v2c.get(first.object, NO_RESULT)
             assert_ec(anchor != NO_RESULT,
                       ErrorCode.UNSUPPORTED_SHAPE,
                       "seeded distributed chains must start from a pattern "
@@ -487,6 +486,24 @@ class DistEngine:
                 plan.steps.append(step)
                 continue
 
+            if s > 0:
+                # const_to_known mid-chain (sparql.hpp:138-163's c2k): the
+                # membership "bound ?o in adj(const, p, d)" is exactly
+                # "const in adj(?o, p, flip(d))" — a member step against the
+                # reverse segment anchored on the bound object column
+                ocol = v2c.get(o, NO_RESULT) if o < 0 else NO_RESULT
+                assert_ec(ocol != NO_RESULT, ErrorCode.UNSUPPORTED_SHAPE,
+                          "const subject mid-chain needs a bound object")
+                fd = OUT if d == IN else IN
+                exch_cap = 0
+                if aligned_col != ocol:
+                    exch_cap = exch_cap_for(i, ocol)
+                self.sstore.segment(p, fd)  # ensure staged
+                plan.steps.append(_Step(
+                    kind="member", pid=p, dir=fd, col=ocol, vals_col=-1,
+                    const=s, cap=cap_for(i, est_rows), exch_cap=exch_cap))
+                aligned_col = ocol
+                continue
             col = v2c.get(s, NO_RESULT)
             assert_ec(col != NO_RESULT, ErrorCode.UNSUPPORTED_SHAPE,
                       "distributed steps must anchor on a KNOWN subject")
