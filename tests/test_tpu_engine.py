@@ -305,3 +305,52 @@ def test_versatile_kuu_on_device(world):
     assert qt2.result.status_code == 0
     assert rows(qt2) == rows(qc2)
     assert qc2.result.nrows > 0
+
+
+def test_versatile_const_shapes_on_device(world):
+    """The remaining VERSATILE shapes run on the device chain too:
+    const_unknown_unknown / const_unknown_const start via a host CSR init
+    (sparql.hpp:246-290), known_unknown_const mid-chain via expand2 + an
+    equality fold on the value row (sparql.hpp:651-699). The reference GPU
+    engine refuses all of these; ours must match the CPU kernels exactly."""
+    from wukong_tpu.sparql.ir import Pattern, SPARQLQuery
+    from wukong_tpu.types import IN, OUT, TYPE_ID
+
+    g, ss = world
+    cpu = CPUEngine(g, ss)
+    tpu = TPUEngine(g, ss)
+    dept0 = ss.str2id("<http://www.Department0.University0.edu>")
+    univ0 = ss.str2id("<http://www.University0.edu>")
+    fp = ss.str2id("<http://swat.cse.lehigh.edu/onto/univ-bench.owl#FullProfessor>")
+
+    def run(eng, pats, req):
+        q = SPARQLQuery()
+        q.result.nvars = len(req)
+        q.pattern_group.patterns = [Pattern(*p) for p in pats]
+        q.result.required_vars = list(req)
+        eng.execute(q, from_proxy=False)
+        assert q.result.status_code == 0, q.result.status_code
+        cols = [q.result.var2col(v) for v in req]
+        return sorted(map(tuple, np.asarray(q.result.table)[:, cols].tolist()))
+
+    def cmp(pats, req, name):
+        a = run(cpu, pats, req)
+        b = run(tpu, pats, req)
+        assert a == b, (name, len(a), len(b))
+        assert len(a) > 0, (name, "vacuous: empty result")
+        return a
+
+    # const_unknown_unknown start: Dept0 ?P ?Y (full combined adjacency)
+    cmp([(dept0, -9, OUT, -1)], [-9, -1], "c_u_u")
+    # const_unknown_const: Dept0 ?P Univ0 (= subOrganizationOf)
+    got = cmp([(dept0, -9, OUT, univ0)], [-9], "c_u_c")
+    sub = ss.str2id(
+        "<http://swat.cse.lehigh.edu/onto/univ-bench.owl#subOrganizationOf>")
+    assert (sub,) in got
+    # known_unknown_const mid-chain: FullProfessors with any edge to Univ0
+    # (degreeFrom flavors) — type-index start keeps the k_u_c mid-chain
+    cmp([(fp, TYPE_ID, IN, -1), (-1, -9, OUT, univ0)], [-1, -9], "k_u_c")
+    # and a continuation AFTER the fold (normal expand on the filtered rows)
+    works = ss.str2id("<http://swat.cse.lehigh.edu/onto/univ-bench.owl#worksFor>")
+    cmp([(fp, TYPE_ID, IN, -1), (-1, -9, OUT, univ0),
+         (-1, works, OUT, -2)], [-1, -9, -2], "k_u_c_then_expand")

@@ -9,10 +9,12 @@ gpu_engine_cuda.hpp:189-196).
 
 Scope EXCEEDS the reference's accelerator support matrix
 (gpu_engine.hpp:267-333): index/const starts, known_to_unknown/known/const,
-and the VERSATILE known_unknown_unknown shape (combined-adjacency segment +
-expand2 — the reference refuses every versatile shape on GPU) run on device;
-other versatile shapes, attribute patterns, OPTIONAL, and UNION fall back to
-the CPU oracle kernels via a host sync — graceful degradation, not refusal.
+and every VERSATILE shape with an unbound predicate — known_unknown_unknown
+and known_unknown_const via the combined-adjacency segment + expand2,
+const_unknown_unknown / const_unknown_const via a host CSR init (the
+reference refuses every versatile shape on GPU) — run on device; attribute
+patterns, bound-predicate versatiles, OPTIONAL, and UNION fall back to the
+CPU oracle kernels via a host sync — graceful degradation, not refusal.
 
 Execution discipline (measured on the axon-tunneled chip): a host<->device sync
 costs ~70 ms regardless of payload, while dispatches pipeline asynchronously at
@@ -136,13 +138,21 @@ class TPUEngine:
 
         if device_steps:
             # pin this query's segments for the chain's lifetime (the
-            # GPUCache conflict-aware eviction analogue, gpu_cache.hpp)
+            # GPUCache conflict-aware eviction analogue, gpu_cache.hpp).
+            # A versatile CONST start is answered by one host CSR walk —
+            # staging the whole-graph combined segment for it would be the
+            # largest staging in the system for a one-lookup step, so it is
+            # excluded (like the index-origin start below).
+            first = q.get_pattern(q.pattern_step)
+            vlo = q.pattern_step
+            if q.result.col_num == 0 and first.predicate < 0 \
+                    and first.subject > 0:
+                vlo = q.pattern_step + 1
             pins = [(q.get_pattern(i).predicate, q.get_pattern(i).direction)
                     for i in range(q.pattern_step, q.pattern_step + device_steps)
                     if q.get_pattern(i).predicate > 0]
             pins += [("vpv", int(q.get_pattern(i).direction))
-                     for i in range(q.pattern_step,
-                                    q.pattern_step + device_steps)
+                     for i in range(vlo, q.pattern_step + device_steps)
                      if q.get_pattern(i).predicate < 0]
             self.dstore.pin(pins)
             if Global.gpu_enable_pipeline:
@@ -153,7 +163,7 @@ class TPUEngine:
                 # index-origin START consumes an index list, not a segment —
                 # staging its (TYPE_ID, dir) segment would build the whole
                 # type CSR for nothing, so it is skipped.
-                lo = q.pattern_step
+                lo = max(q.pattern_step, vlo)
                 if lo == 0 and q.start_from_index() \
                         and _is_index_start(q.get_pattern(0)):
                     lo = 1
@@ -245,6 +255,43 @@ class TPUEngine:
                 state.begin(table, nn, end, est_rows=real)
                 state.local_var = end
                 return
+            if pid < 0:
+                # versatile const start (CONST ?p ?y / CONST1 ?p CONST2,
+                # sparql.hpp:246-290's const_unknown_* — the reference GPU
+                # engine refuses these): the const's combined adjacency is
+                # one host CSR lookup, so the table is built host-side and
+                # the device chain continues from it
+                assert_ec(q.result.col_num == 0 and state.width == 0,
+                          ErrorCode.FIRST_PATTERN_ERROR)
+                prs, vls = [], []
+                for p in self.g.get_triples(start, PREDICATE_ID, d):
+                    nb = self.g.get_triples(start, int(p), d)
+                    prs.extend([int(p)] * len(nb))
+                    vls.extend(int(v) for v in nb)
+                prs = np.asarray(prs, dtype=np.int64)
+                vls = np.asarray(vls, dtype=np.int64)
+                if end > 0:  # const object: keep matching pairs, bind p only
+                    sel = vls == end
+                    cols_data, bind = [prs[sel]], [pid]
+                else:
+                    cols_data, bind = [prs, vls], [pid, end]
+                real = len(cols_data[0])
+                assert_ec(real <= self.cap_max, ErrorCode.UNKNOWN_PATTERN,
+                          f"versatile const start ({real:,} pairs) exceeds "
+                          f"table_capacity_max ({self.cap_max:,})")
+                cap = cap_override.get(step) or K.next_capacity(
+                    max(real, 1), self.cap_min, self.cap_max)
+                pad = np.zeros((len(cols_data), cap), dtype=np.int32)
+                for r, cd in enumerate(cols_data):
+                    pad[r, :real] = cd
+                state.table = jnp.asarray(pad)
+                state.n = jnp.int32(real)
+                for v in bind:
+                    state.cols[v] = state.width
+                    state.new_cols.append((v, state.width))
+                    state.width += 1
+                state.est_rows = max(real, 1)
+                return
             # const_to_unknown start
             assert_ec(q.result.col_num == 0 and state.width == 0,
                       ErrorCode.FIRST_PATTERN_ERROR)
@@ -259,11 +306,12 @@ class TPUEngine:
 
         col = anchor_col if anchor_col is not None else state.col_of(start)
         assert_ec(col is not None, ErrorCode.VERTEX_INVALID)
-        if pid < 0:  # versatile known_unknown_unknown via expand2
+        if pid < 0:  # versatile known_unknown_* via expand2
             vseg = self.dstore.versatile_segment(d)
             if vseg is None:
                 state.append_empty_col(pid)
-                state.append_empty_col(end)
+                if end < 0:
+                    state.append_empty_col(end)
                 return
             fan = max(1.0, vseg.num_edges / max(vseg.num_keys, 1)) * 2
             est = min(int(state.est_rows * fan) or 1, self.cap_max)
@@ -277,6 +325,24 @@ class TPUEngine:
                 max_probe=vseg.max_probe, use_pallas=up,
                 fpw0=vseg.fpw0 if fd else None,
                 fpw1=vseg.fpw1 if fd else None, fp_dup=fd)
+            if end > 0:
+                # known_unknown_const (?x ?p CONST, sparql.hpp:651-699):
+                # filter the expanded pairs to value == const inside the
+                # same program, then drop the value row — the surviving
+                # table binds only the predicate column (CPU layout parity)
+                state.totals.append((step, total, cap_out))
+                keep = (jnp.arange(cap_out, dtype=jnp.int32) < nn) \
+                    & (out[-1] == jnp.int32(end))
+                out, nn = K.compact(out, keep)
+                state.table = out[:-1]
+                state.n = nn
+                state.cols[pid] = state.width
+                state.new_cols.append((pid, state.width))
+                state.width += 1
+                # the fold only shrinks the expansion, so the expand estimate
+                # is a safe (over-)estimate for downstream capacity sizing
+                state.est_rows = max(min(est, cap_out), 1)
+                return
             state.advance_expand2(out, nn, pid, end, total, cap_out, step,
                                   est_rows=min(est, cap_out))
             return
@@ -567,18 +633,25 @@ class TPUEngine:
         if pat.pred_type != int(AttrType.SID_t):
             return False
         if pat.predicate < 0:
-            # VERSATILE: the known_unknown_unknown shape (?x ?p ?y, x bound,
-            # p and y fresh vars) runs on device via the combined-adjacency
-            # segment + expand2 (beyond the reference, whose GPU engine
-            # refuses every versatile shape — gpu_engine.hpp:267-333).
-            # Other versatile shapes (const anchors, bound objects) stay
-            # on the host path.
-            return (Global.enable_versatile
-                    and pat.subject < 0
-                    and probe.col_of(pat.subject) is not None
-                    and probe.col_of(pat.predicate) is None
-                    and pat.object < 0
-                    and probe.col_of(pat.object) is None)
+            # VERSATILE shapes (beyond the reference, whose GPU engine
+            # refuses all of them — gpu_engine.hpp:267-333):
+            #   known_unknown_unknown  (?x ?p ?y, x bound)  expand2
+            #   known_unknown_const   (?x ?p CONST, x bound) expand2 + filter
+            #   const_unknown_unknown (CONST ?p ?y, start)   host CSR init
+            #   const_unknown_const   (CONST1 ?p CONST2)     host CSR init
+            # A bound predicate var stays on the host path (the CPU engine
+            # rejects it too — there is no such reference kernel).
+            if not Global.enable_versatile \
+                    or probe.col_of(pat.predicate) is not None:
+                return False
+            if is_first and probe.width == 0:
+                return pat.subject > 0  # const versatile start
+            if not (pat.subject < 0
+                    and probe.col_of(pat.subject) is not None):
+                return False
+            if pat.object < 0:
+                return probe.col_of(pat.object) is None
+            return True  # const object: expand2 + equality fold
         if is_first and q.pattern_step == 0 and q.start_from_index():
             # index_to_known is host-only (like the reference GPU engine)
             return probe.col_of(pat.object) is None
@@ -613,6 +686,13 @@ class _MetaResult:
 
     def bind(self, pat) -> None:
         if self.width == 0:
+            if pat.predicate < 0:  # versatile const start: pid col first
+                self.cols[pat.predicate] = 0
+                self.width = 1
+                if pat.object < 0:
+                    self.cols[pat.object] = 1
+                    self.width = 2
+                return
             self.cols[pat.object], self.width = 0, 1
             return
         if pat.predicate < 0 and self.col_of(pat.predicate) is None:
