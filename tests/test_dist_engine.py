@@ -72,13 +72,38 @@ def test_dist_blind_counts(world):
     assert qd.result.nrows == qc.result.nrows
 
 
-def test_dist_rejects_versatile(world):
+def test_dist_versatile_const_start(world):
+    """?X ?P <const> flips to a versatile const start (owner-partition CSR
+    walk) and must match the CPU engine; bound-object versatile stays
+    rejected (CPU parity — no such reference kernel)."""
     ss, cpu, dist = world
-    q = Parser(ss).parse(
-        "SELECT ?X ?P WHERE { ?X ?P <http://www.Department0.University0.edu> . }")
-    heuristic_plan(q)
+    text = ("SELECT ?X ?P WHERE "
+            "{ ?X ?P <http://www.Department0.University0.edu> . }")
+    qc = Parser(ss).parse(text)
+    heuristic_plan(qc)
+    cpu.execute(qc)
+    assert qc.result.status_code == 0 and qc.result.nrows > 0
+    qd = Parser(ss).parse(text)
+    heuristic_plan(qd)
+    dist.execute(qd)
+    assert qd.result.status_code == 0
+    assert _rows_of(qd.result) == _rows_of(qc.result)
+
+    # bound-object versatile (?x ?p ?y, BOTH bound): unsupported everywhere
+    from wukong_tpu.sparql.ir import Pattern, SPARQLQuery
+    from wukong_tpu.types import IN, OUT, PREDICATE_ID
+
+    works = ss.str2id("<http://swat.cse.lehigh.edu/onto/univ-bench.owl#worksFor>")
+    q = SPARQLQuery()
+    q.result.nvars = 3
+    q.pattern_group.patterns = [
+        Pattern(works, PREDICATE_ID, IN, -1),
+        Pattern(-1, works, OUT, -2),
+        Pattern(-1, -3, OUT, -2),
+    ]
+    q.result.required_vars = [-1, -2, -3]
     dist.execute(q)
-    assert q.result.status_code != 0  # versatile -> unsupported in dist v1
+    assert q.result.status_code != 0
 
 
 def test_dist_capacity_retry(world, monkeypatch):
@@ -546,3 +571,44 @@ def test_dist_seeded_union_c2k_branch(world):
     assert qd.result.status_code == 0
     assert _rows_of(qd.result) == _rows_of(qc.result)
     assert qc.result.nrows > 0
+
+
+def test_dist_versatile_const_shapes(world):
+    """Distributed const_unknown_const and known_unknown_const: owner-shard
+    CSR start / expand2 + equality fold inside the compiled chain."""
+    from wukong_tpu.sparql.ir import Pattern, SPARQLQuery
+    from wukong_tpu.types import IN, OUT, TYPE_ID
+
+    ss, cpu, dist = world
+    dept0 = ss.str2id("<http://www.Department0.University0.edu>")
+    univ0 = ss.str2id("<http://www.University0.edu>")
+    fp = ss.str2id("<http://swat.cse.lehigh.edu/onto/univ-bench.owl#FullProfessor>")
+    works = ss.str2id("<http://swat.cse.lehigh.edu/onto/univ-bench.owl#worksFor>")
+
+    def run(eng, pats, req):
+        q = SPARQLQuery()
+        q.result.nvars = len(req)
+        q.pattern_group.patterns = [Pattern(*p) for p in pats]
+        q.result.required_vars = list(req)
+        eng.execute(q, from_proxy=False)
+        assert q.result.status_code == 0, q.result.status_code
+        cols = [q.result.var2col(v) for v in req]
+        return sorted(map(tuple, np.asarray(q.result.table)[:, cols].tolist()))
+
+    def cmp(pats, req, name):
+        a = run(cpu, pats, req)
+        b = run(dist, pats, req)
+        assert a == b, (name, len(a), len(b))
+        assert len(a) > 0, (name, "vacuous: empty result")
+
+    # const_unknown_const start: Dept0 ?P Univ0
+    cmp([(dept0, -9, OUT, univ0)], [-9], "c_u_c")
+    # versatile const start continuing into a distributed chain: everyone
+    # with an edge INTO Dept0, then where they work
+    cmp([(dept0, -9, IN, -1), (-1, works, OUT, -2)], [-9, -1, -2],
+        "c_u_u_then_chain")
+    # known_unknown_const mid-chain inside the compiled shard_map chain
+    cmp([(fp, TYPE_ID, IN, -1), (-1, -9, OUT, univ0)], [-1, -9], "k_u_c")
+    # continuation after the fold
+    cmp([(fp, TYPE_ID, IN, -1), (-1, -9, OUT, univ0), (-1, works, OUT, -2)],
+        [-1, -9, -2], "k_u_c_then_expand")

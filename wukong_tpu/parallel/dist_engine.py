@@ -164,6 +164,14 @@ class DistEngine:
                       ErrorCode.UNSUPPORTED_SHAPE,
                       "SID patterns after attr patterns are unsupported "
                       "in the distributed engine")
+        first = pats[q.pattern_step] if split > q.pattern_step else None
+        if first is not None and q.result.col_num == 0 \
+                and first.predicate < 0 and first.subject > 0:
+            # versatile const start (CONST ?p ?y / CONST1 ?p CONST2): the
+            # const's combined adjacency is one CSR walk on its owner
+            # partition — done host-side, the rest of the chain runs as a
+            # seeded distributed child (like the single-chip engine)
+            self._versatile_const_start(q, first)
         if split > q.pattern_step:
             seed = None
             if q.result.col_num > 0:  # seeded child (UNION branch on a table)
@@ -171,6 +179,23 @@ class DistEngine:
             self._run_device_bgp(q, n_steps=split - q.pattern_step, seed=seed)
         while not q.done_patterns():  # attr tail (or attr-only query)
             self._attr_host()._execute_one_pattern(q)
+
+    def _versatile_const_start(self, q: SPARQLQuery, pat) -> None:
+        """Delegate to a CPU engine over the const's owner partition — the
+        owner holds the full combined adjacency (vertices are placed by
+        hash on both subject and object), and the CPU kernels carry the
+        exact const_unknown_* semantics (incl. start_from_index rejection
+        of malformed tpid starts)."""
+        from wukong_tpu.engine.cpu import CPUEngine
+        from wukong_tpu.utils.mathutil import hash_mod
+
+        owner = int(hash_mod(int(np.int32(pat.subject)), self.D))
+        if not hasattr(self, "_owner_hosts"):
+            self._owner_hosts: dict = {}
+        if owner not in self._owner_hosts:
+            self._owner_hosts[owner] = CPUEngine(self.sstore.stores[owner],
+                                                 self.str_server)
+        self._owner_hosts[owner]._execute_one_pattern(q)
 
     def _execute_unions_dist(self, q: SPARQLQuery) -> None:
         """Each UNION branch is a distributed child seeded with the parent's
@@ -415,34 +440,41 @@ class DistEngine:
                       ErrorCode.UNSUPPORTED_SHAPE,
                       "attr patterns are host-side in the distributed engine")
             if p < 0:
-                # VERSATILE known_unknown_unknown (?x ?p ?y, x bound): each
-                # shard expands against its combined adjacency (beyond the
-                # reference — its accelerator refuses every versatile shape).
-                # Other versatile shapes stay host-side.
+                # VERSATILE known_unknown_unknown (?x ?p ?y, x bound) and
+                # known_unknown_const (?x ?p CONST): each shard expands
+                # against its combined adjacency; a const object folds to an
+                # equality filter inside the same program (beyond the
+                # reference — its accelerator refuses every versatile
+                # shape). A bound predicate or bound object stays host-side
+                # (the CPU engine rejects those too).
                 col = v2c.get(s, NO_RESULT) if s < 0 else NO_RESULT
-                assert_ec(width > 0 and col != NO_RESULT
-                          and p not in v2c and o < 0 and o not in v2c,
+                assert_ec(width > 0 and col != NO_RESULT and p not in v2c
+                          and (o > 0 or o not in v2c),
                           ErrorCode.UNSUPPORTED_SHAPE,
-                          "distributed versatile supports ?x ?p ?y with "
-                          "x bound and p, y fresh")
+                          "distributed versatile supports ?x ?p ?y / "
+                          "?x ?p CONST with x bound and p fresh")
                 exch_cap = 0
                 if aligned_col != col:
                     exch_cap = exch_cap_for(i, col)
                 vseg = self.sstore.versatile_segment(d)
                 avg = vseg.avg_deg if vseg else 0.0
                 est_rows = int(max(est_rows * max(avg, 0.1) * 2, 1))
+                kind = "expand_versatile" if o < 0 else "expand_versatile_const"
                 plan.steps.append(_Step(
-                    kind="expand_versatile", pid=0, dir=d, col=col,
+                    kind=kind, pid=0, dir=d, col=col,
+                    const=(o if o > 0 else 0),
                     cap=min(cap_for(i, est_rows), self.cap_max),
                     exch_cap=exch_cap, new_col=True))
                 fwd_max = vseg.max_deg if vseg else 1
                 for c in list(col_mult):
                     col_mult[c] = min(col_mult[c] * fwd_max, MULT_CAP)
-                # the two fresh columns' multiplicity bounds are unknown
+                # the fresh columns' multiplicity bounds are unknown
                 # (reverse combined degrees aren't tracked) — leave untracked
                 v2c[p] = width
-                v2c[o] = width + 1
-                width += 2
+                width += 1
+                if o < 0:
+                    v2c[o] = width
+                    width += 1
                 aligned_col = col
                 continue
             if i == 0 and seed is None and q.pattern_step == 0 \
@@ -575,7 +607,7 @@ class DistEngine:
                 idx = self.sstore.index_list(s.pid, s.dir)
                 args.append((idx.edges, self._real_lens_arr(idx)))
                 bounds.append((0, 0))
-            elif s.kind == "expand_versatile":
+            elif s.kind in ("expand_versatile", "expand_versatile_const"):
                 vseg = self.sstore.versatile_segment(s.dir)
                 if vseg is None:
                     args.append(None)
@@ -667,7 +699,7 @@ class DistEngine:
         probes = {}
         depths = {}
         for i, s in enumerate(steps):
-            if s.kind == "expand_versatile":
+            if s.kind in ("expand_versatile", "expand_versatile_const"):
                 # the combined segment's OWN probe bound — segment(pid=0)
                 # would resolve to nothing and silently bake max_probe=1,
                 # truncating probes on any hash-skewed versatile table
@@ -735,11 +767,13 @@ class DistEngine:
                     continue
 
                 arrs = per_step[i]
-                if s.kind == "expand_versatile":
+                if s.kind in ("expand_versatile", "expand_versatile_const"):
+                    fold = s.kind == "expand_versatile_const"
                     if arrs is None:
                         table = jnp.concatenate(
                             [table,
-                             jnp.zeros((2, table.shape[1]), jnp.int32)],
+                             jnp.zeros((1 if fold else 2, table.shape[1]),
+                                       jnp.int32)],
                             axis=0)
                         n = jnp.int32(0)
                         continue
@@ -748,6 +782,14 @@ class DistEngine:
                         table, n, bkey, bstart, bdeg, edges2, edges,
                         col=s.col, cap_out=s.cap, max_probe=probes[i])
                     totals[i] = jnp.maximum(totals[i], tot)
+                    if fold:
+                        # known_unknown_const: keep value == const rows,
+                        # drop the value row — the surviving table binds
+                        # only the predicate column
+                        keep = (jnp.arange(s.cap, dtype=jnp.int32) < n) \
+                            & (table[-1] == jnp.int32(s.const))
+                        table, n = K.compact.__wrapped__(table, keep)
+                        table = table[:-1]
                 elif s.kind in ("expand", "expand_type_all"):
                     if s.kind == "expand_type_all":
                         table, n = _allgather_rows(table, n, D, axis)
