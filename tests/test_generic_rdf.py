@@ -89,6 +89,26 @@ def _mk_query(hub, pid):
     return q
 
 
+def _fuzz_dist(triples):
+    """Module-cached 8-way DistEngine (one build for all fuzz seeds)."""
+    from wukong_tpu.parallel.dist_engine import DistEngine
+    from wukong_tpu.parallel.mesh import make_mesh
+
+    if "dist" not in _fuzz_dist_cache:
+        _fuzz_dist_cache["dist"] = DistEngine(
+            build_all_partitions(triples, 8), None, make_mesh(8))
+    return _fuzz_dist_cache["dist"]
+
+
+def _mk_bgp_query(raw, req):
+    """(s, p, o) pattern triples (OUT direction) -> executable query."""
+    q = SPARQLQuery()
+    q.pattern_group.patterns = [Pattern(s, p, OUT, o) for (s, p, o) in raw]
+    q.result.nvars = len(req)
+    q.result.required_vars = list(req)
+    return q
+
+
 @pytest.mark.parametrize("seed", range(6))
 def test_fuzz_random_bgps_all_engines(world, seed, eight_cpu_devices):
     """Differential fuzz: random BGP shapes (chains, stars, const anchors,
@@ -102,13 +122,7 @@ def test_fuzz_random_bgps_all_engines(world, seed, eight_cpu_devices):
     planner = Planner(stats)
     cpu = CPUEngine(g, None)
     tpu = TPUEngine(g, None, stats=stats)
-    from wukong_tpu.parallel.dist_engine import DistEngine
-    from wukong_tpu.parallel.mesh import make_mesh
-
-    if "dist" not in _fuzz_dist_cache:
-        _fuzz_dist_cache["dist"] = DistEngine(
-            build_all_partitions(triples, 8), None, make_mesh(8))
-    dist = _fuzz_dist_cache["dist"]
+    dist = _fuzz_dist(triples)
     pids = [int(p) for p in np.unique(triples[:, 1]) if p != TYPE_ID]
     norm = triples[triples[:, 1] != TYPE_ID]
     typed = triples[triples[:, 1] == TYPE_ID]
@@ -149,21 +163,12 @@ def test_fuzz_random_bgps_all_engines(world, seed, eight_cpu_devices):
     for _ in range(4):
         raw, req = random_bgp()
         want = sorted(eval_bgp(idx, raw, req))
-
-        def mk():
-            q = SPARQLQuery()
-            q.pattern_group.patterns = [Pattern(s, p, OUT, o)
-                                        for (s, p, o) in raw]
-            q.result.nvars = len(req)
-            q.result.required_vars = list(req)
-            return q
-
         engines = [("cpu", cpu), ("tpu", tpu)]
         if raw[0][0] > 0:  # const-anchored: dist-plannable shape
             engines.append(("dist", dist))
         outs = {}
         for name, eng in engines:
-            q = mk()
+            q = _mk_bgp_query(raw, req)
             assert planner.generate_plan(q)
             eng.execute(q)
             assert q.result.status_code == 0, (name, raw)
@@ -172,3 +177,56 @@ def test_fuzz_random_bgps_all_engines(world, seed, eight_cpu_devices):
                 map(tuple, np.asarray(q.result.table)[:, cols].tolist()))
         for name, rows in outs.items():
             assert rows == want, f"{name} diverged on {raw}"
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_fuzz_versatile_shapes_all_engines(world, seed, eight_cpu_devices):
+    """Differential fuzz over the VERSATILE (unbound-predicate) shapes:
+    const_unknown_unknown / const_unknown_const starts, known_unknown_const
+    folds, known_unknown_unknown mid-chain — CPU, TPU and distributed
+    engines (every shape is const-anchored, hence dist-plannable) vs the
+    nested-loop oracle. OUT direction only: the combined adjacency includes
+    rdf:type OUT edges, matching the raw-triple oracle (the IN side
+    excludes them by design)."""
+    triples, meta, g, stats = world
+    rng = np.random.default_rng(7000 + seed)
+    idx = TripleIndex(triples)
+    cpu = CPUEngine(g, None)
+    tpu = TPUEngine(g, None, stats=stats)
+    dist = _fuzz_dist(triples)
+    pids = [int(p) for p in np.unique(triples[:, 1]) if p != TYPE_ID]
+    norm = triples[triples[:, 1] != TYPE_ID]
+
+    def shapes():
+        row = norm[rng.integers(0, len(norm))]
+        s0, p0, o0 = int(row[0]), int(row[1]), int(row[2])
+        row2 = norm[rng.integers(0, len(norm))]
+        pid = int(rng.choice(pids))
+        # a second-hop object reachable from o0 (=> the k_u_c fold below is
+        # non-empty for at least the o0 row); fall back to an arbitrary one
+        hop2 = norm[norm[:, 0] == o0]
+        o2 = int(hop2[0, 2]) if len(hop2) else int(row2[2])
+        return [
+            # versatile const start, then a normal expand off the value
+            [(s0, -20, -1), (-1, pid, -2)],
+            # const_unknown_const (real edge => non-empty)
+            [(s0, -20, o0)],
+            # known_unknown_const fold mid-chain (reachable object)
+            [(s0, p0, -1), (-1, -20, o2)],
+            # known_unknown_unknown mid-chain off a const-anchored start
+            [(s0, p0, -1), (-1, -20, -21)],
+            # k_u_c against an arbitrary (often non-matching) object
+            [(s0, p0, -1), (-1, -20, int(row2[2]))],
+        ]
+
+    for raw in shapes():
+        req = sorted({v for pat in raw for v in pat if v < 0}, reverse=True)
+        want = sorted(eval_bgp(idx, raw, req))
+        for name, eng in (("cpu", cpu), ("tpu", tpu), ("dist", dist)):
+            q = _mk_bgp_query(raw, req)
+            eng.execute(q, from_proxy=False)
+            assert q.result.status_code == 0, (name, raw)
+            cols = [q.result.var2col(v) for v in req]
+            got = sorted(
+                map(tuple, np.asarray(q.result.table)[:, cols].tolist()))
+            assert got == want, f"{name} diverged on {raw}"
