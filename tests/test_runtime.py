@@ -346,3 +346,26 @@ def test_engine_pool_failure_detection_and_respawn():
         assert threading.active_count() >= 1
     finally:
         pool.stop()
+
+
+def test_emulator_inflight_window(proxy, monkeypatch):
+    """After a class's first device batch learns capacities, subsequent
+    draws ride run_batch_const_many: W=parallel batches dispatch
+    back-to-back and sync once (the device path's honoring of -p)."""
+    monkeypatch.setattr(Global, "enable_tpu", True)
+    mix = load_mix_config(f"{EMU}/mix_config", proxy.str_server)
+    mix.templates = mix.templates[:1]  # one class => deterministic warm-up
+    mix.heavies = []
+    mix.weights = mix.weights[:1]
+    calls = []
+    orig = proxy.tpu.merge.run_batch_const_many
+
+    def spy(q, batches):
+        calls.append(len(batches))
+        return orig(q, batches)
+
+    monkeypatch.setattr(proxy.tpu.merge, "run_batch_const_many", spy)
+    out = Emulator(proxy).run(mix, duration_s=8.0, warmup_s=0.5, batch=64,
+                              parallel=4)
+    assert out["thpt_qps"] > 0
+    assert calls and all(w == 4 for w in calls), calls

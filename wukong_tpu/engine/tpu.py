@@ -415,23 +415,7 @@ class TPUEngine:
         import jax.numpy as jnp
 
         pats = q.pattern_group.patterns
-        assert_ec(len(pats) > 0 and pats[0].subject > 0,
-                  ErrorCode.UNKNOWN_PLAN, "batch execution needs a const start")
-        # validate the WHOLE chain up front: every step must be device-
-        # supported (the start constant column counts as known for steps that
-        # re-anchor on it — the reference plans such shapes as known_to_*)
-        probe = _MetaResult(q.result)
-        probe.cols[pats[0].subject] = 1
-        probe.width = 2
-        for k, pat in enumerate(pats):
-            assert_ec(pat.pred_type == int(AttrType.SID_t) and pat.predicate >= 0,
-                      ErrorCode.UNKNOWN_PATTERN,
-                      "batch steps must have const SID predicates")
-            if k > 0:
-                assert_ec(probe.col_of(pat.subject) is not None,
-                          ErrorCode.UNKNOWN_PATTERN,
-                          "batch steps must anchor on a bound column")
-            probe.bind(pat)
+        self._check_batch_const(q)
         B = len(consts)
         if q.planner_empty and Global.enable_empty_shortcircuit:
             return np.zeros(B, dtype=np.int64)
@@ -452,6 +436,41 @@ class TPUEngine:
             return 0  # dispatch every pattern (the const col pre-binds step 0)
 
         return self._run_batch_chain(q, B, make_init, est_mult=float(B))
+
+    def _check_batch_const(self, q: SPARQLQuery) -> None:
+        """Shared validation for the const-batch entry points: every step
+        must be device-supported (the start constant column counts as known
+        for steps that re-anchor on it — the reference plans such shapes as
+        known_to_*)."""
+        pats = q.pattern_group.patterns
+        assert_ec(len(pats) > 0 and pats[0].subject > 0,
+                  ErrorCode.UNKNOWN_PLAN, "batch execution needs a const start")
+        probe = _MetaResult(q.result)
+        probe.cols[pats[0].subject] = 1
+        probe.width = 2
+        for k, pat in enumerate(pats):
+            assert_ec(pat.pred_type == int(AttrType.SID_t) and pat.predicate >= 0,
+                      ErrorCode.UNKNOWN_PATTERN,
+                      "batch steps must have const SID predicates")
+            if k > 0:
+                assert_ec(probe.col_of(pat.subject) is not None,
+                          ErrorCode.UNKNOWN_PATTERN,
+                          "batch steps must anchor on a bound column")
+            probe.bind(pat)
+
+    def execute_batch_many(self, q: SPARQLQuery, consts_list: list) -> list:
+        """K const-batches with as few device syncs as the active path
+        allows (the emulator's in-flight window). Applies the same guards
+        as execute_batch: planner-proved-empty classes answer instantly,
+        the merge path dispatches all K batches back-to-back and syncs
+        ONCE (run_batch_const_many), anything else degrades to a per-batch
+        loop — callers never need routing knowledge."""
+        if q.planner_empty and Global.enable_empty_shortcircuit:
+            return [np.zeros(len(c), dtype=np.int64) for c in consts_list]
+        if Global.enable_merge_join and self.merge.supports(q):
+            self._check_batch_const(q)
+            return self.merge.run_batch_const_many(q, consts_list)
+        return [self.execute_batch(q, c) for c in consts_list]
 
     def execute_batch_index(self, q: SPARQLQuery, B: int,
                             slice_mode: bool = False) -> np.ndarray:
