@@ -216,3 +216,16 @@ def test_stream_expand_in_executor(engines, world, qfile, monkeypatch):
         const = q.pattern_group.patterns[0].subject
         counts = tpu.execute_batch(q, np.full(2, const, dtype=np.int64))
         assert counts.tolist() == [want] * 2
+
+
+def test_run_batch_index_many_matches_single(engines, world):
+    """K windowed replicate heavy batches == K independent run_batch_index."""
+    g, ss = world
+    cpu, tpu = engines
+    q = Parser(ss).parse(open(f"{BASIC}/lubm_q7").read())
+    heuristic_plan(q)
+    single = tpu.merge.run_batch_index(q, 4, False)
+    many = tpu.execute_batch_index_many(q, 4, 3)
+    assert len(many) == 3
+    for counts in many:
+        assert np.array_equal(np.asarray(counts), np.asarray(single))

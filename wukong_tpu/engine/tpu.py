@@ -465,10 +465,10 @@ class TPUEngine:
         the merge path dispatches all K batches back-to-back and syncs
         ONCE (run_batch_const_many), anything else degrades to a per-batch
         loop — callers never need routing knowledge."""
+        self._check_batch_const(q)
         if q.planner_empty and Global.enable_empty_shortcircuit:
             return [np.zeros(len(c), dtype=np.int64) for c in consts_list]
         if Global.enable_merge_join and self.merge.supports(q):
-            self._check_batch_const(q)
             return self.merge.run_batch_const_many(q, consts_list)
         return [self.execute_batch(q, c) for c in consts_list]
 
@@ -487,22 +487,7 @@ class TPUEngine:
         import jax.numpy as jnp
 
         pats = q.pattern_group.patterns
-        assert_ec(len(pats) > 0 and q.start_from_index()
-                  and _is_index_start(pats[0]) and pats[0].object < 0,
-                  ErrorCode.UNKNOWN_PLAN,
-                  "batch-index execution needs an index-origin start")
-        probe = _MetaResult(q.result)
-        probe.cols[pats[0].object] = 1
-        probe.width = 2
-        for k, pat in enumerate(pats):
-            assert_ec(pat.pred_type == int(AttrType.SID_t) and pat.predicate >= 0,
-                      ErrorCode.UNKNOWN_PATTERN,
-                      "batch steps must have const SID predicates")
-            if k > 0:
-                assert_ec(probe.col_of(pat.subject) is not None,
-                          ErrorCode.UNKNOWN_PATTERN,
-                          "batch steps must anchor on a bound column")
-                probe.bind(pat)
+        self._check_batch_index(q)
         if q.planner_empty and Global.enable_empty_shortcircuit:
             return np.zeros(B, dtype=np.int64)
         if Global.enable_merge_join and self.merge.supports(q):
@@ -527,6 +512,38 @@ class TPUEngine:
 
         return self._run_batch_chain(q, B, make_init,
                                      est_mult=1.0 if slice_mode else float(B))
+
+    def _check_batch_index(self, q: SPARQLQuery) -> None:
+        """Shared validation for the index-origin batch entry points."""
+        pats = q.pattern_group.patterns
+        assert_ec(len(pats) > 0 and q.start_from_index()
+                  and _is_index_start(pats[0]) and pats[0].object < 0,
+                  ErrorCode.UNKNOWN_PLAN,
+                  "batch-index execution needs an index-origin start")
+        probe = _MetaResult(q.result)
+        probe.cols[pats[0].object] = 1
+        probe.width = 2
+        for k, pat in enumerate(pats):
+            assert_ec(pat.pred_type == int(AttrType.SID_t) and pat.predicate >= 0,
+                      ErrorCode.UNKNOWN_PATTERN,
+                      "batch steps must have const SID predicates")
+            if k > 0:
+                assert_ec(probe.col_of(pat.subject) is not None,
+                          ErrorCode.UNKNOWN_PATTERN,
+                          "batch steps must anchor on a bound column")
+                probe.bind(pat)
+
+    def execute_batch_index_many(self, q: SPARQLQuery, B: int,
+                                 K_batches: int) -> list:
+        """K replicate-mode heavy batches with as few device syncs as the
+        active path allows (the heavy-class in-flight window) — same guard
+        structure as execute_batch_many."""
+        self._check_batch_index(q)
+        if q.planner_empty and Global.enable_empty_shortcircuit:
+            return [np.zeros(B, dtype=np.int64) for _ in range(K_batches)]
+        if Global.enable_merge_join and self.merge.supports(q):
+            return self.merge.run_batch_index_many(q, B, K_batches)
+        return [self.execute_batch_index(q, B) for _ in range(K_batches)]
 
     def _run_batch_chain(self, q: SPARQLQuery, B: int, make_init,
                          est_mult: float = 1.0) -> np.ndarray:

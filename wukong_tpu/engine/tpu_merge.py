@@ -179,22 +179,29 @@ class MergeExecutor:
                   f"table_capacity_max ({eng.cap_max:,})")
 
         def init(state: _MergeState):
-            cap0 = K.next_capacity(max(total0, 1), eng.cap_min, eng.cap_max)
-            if slice_mode:
-                vals, n = K.init_from_list(edges, jnp.int32(real), cap0)
-            else:
-                tab, n = K.init_batch_index(edges, jnp.int32(real), B=B,
-                                            cap=cap0, slice_mode=False)
-                vals = tab[1:2]
-            state.levels.append(_Level(pats[0].object, vals[0], None))
-            state.var_level[pats[0].object] = 0
-            state.n = n
-            state.est_rows = max(total0, 1)
+            self._init_index(state, pats, edges, real, B, slice_mode, total0)
             return 1
 
         counts = self._run(q, pats, init, B, r, slice_mode,
                            mode="slice" if slice_mode else "rep")
         return counts
+
+    def _init_index(self, state: "_MergeState", pats, edges, real, B: int,
+                    slice_mode: bool, total0: int) -> None:
+        import jax.numpy as jnp
+
+        eng = self.eng
+        cap0 = K.next_capacity(max(total0, 1), eng.cap_min, eng.cap_max)
+        if slice_mode:
+            vals, n = K.init_from_list(edges, jnp.int32(real), cap0)
+        else:
+            tab, n = K.init_batch_index(edges, jnp.int32(real), B=B,
+                                        cap=cap0, slice_mode=False)
+            vals = tab[1:2]
+        state.levels.append(_Level(pats[0].object, vals[0], None))
+        state.var_level[pats[0].object] = 0
+        state.n = n
+        state.est_rows = max(total0, 1)
 
     def run_batch_const(self, q: SPARQLQuery,
                         consts: np.ndarray) -> np.ndarray:
@@ -207,6 +214,39 @@ class MergeExecutor:
 
         return self._run(q, pats, init, B, 1, False, mode="const")
 
+    def run_batch_index_many(self, q: SPARQLQuery, B: int,
+                             K_batches: int) -> list:
+        """Dispatch K replicate-mode index batches back-to-back and sync
+        ONCE — the heavy-class in-flight window. Each batch is an
+        independent chain at the same learned capacities, so throughput
+        scales with K without growing any chain's capacity class. Batches
+        that still overflow re-run individually (slow path)."""
+        eng = self.eng
+        pats = q.pattern_group.patterns
+        edges, real = eng.dstore.index_list(pats[0].subject,
+                                            pats[0].direction)
+        total0 = real * B
+        assert_ec(total0 <= eng.cap_max, ErrorCode.UNKNOWN_PATTERN,
+                  f"batch-index start ({total0:,} rows) exceeds "
+                  f"table_capacity_max ({eng.cap_max:,})")
+
+        def dispatch_one(_spec, folds):
+            cap_override = dict(
+                self._cap_memo.get(self._key(pats, B, "rep"), {}))
+            state = _MergeState()
+            self._init_index(state, pats, edges, real, B, False, total0)
+            for k, pat, _kind, fold in self.classify(
+                    pats, folds, index_mode=True):
+                self._dispatch(q, pat, k, state, cap_override, {}, fold)
+            counts = K.qid_counts_pos0(state.pos0(), state.n,
+                                       state.live_mask(), B=B,
+                                       r=max(real, 1), slice_mode=False)
+            return counts, state.totals
+
+        return self._run_many(pats, True, list(range(K_batches)),
+                              dispatch_one,
+                              lambda _spec: self.run_batch_index(q, B, False))
+
     # ------------------------------------------------------------------
     def run_batch_const_many(self, q: SPARQLQuery,
                              consts_list: list) -> list:
@@ -215,37 +255,48 @@ class MergeExecutor:
         device: the ~45-70 ms relay sync amortizes over every batch in the
         window. Requires learned capacities (a prior run_batch_const);
         batches that still overflow re-run individually."""
+        pats = q.pattern_group.patterns
+
+        def dispatch_one(consts, folds):
+            B = len(consts)
+            cap_override = dict(
+                self._cap_memo.get(self._key(pats, B, "const"), {}))
+            state = _MergeState()
+            self._init_const(state, pats, consts)
+            for k, pat, _kind, fold in self.classify(
+                    pats, folds, index_mode=False):
+                self._dispatch(q, pat, k, state, cap_override, {}, fold)
+            counts = K.qid_counts_pos0(state.pos0(), state.n,
+                                       state.live_mask(), B=B, r=1,
+                                       slice_mode=False)
+            return counts, state.totals
+
+        return self._run_many(pats, False, consts_list, dispatch_one,
+                              lambda consts: self.run_batch_const(q, consts))
+
+    def _run_many(self, pats, index_mode: bool, specs: list, dispatch_one,
+                  slow_one) -> list:
+        """THE single in-flight-window scaffold: pin once, dispatch every
+        batch back-to-back, device_get the whole flight in one sync, and
+        re-run overflowing batches individually via `slow_one` (which
+        retries internally and re-learns capacities for later windows)."""
         import jax
 
         eng = self.eng
-        pats = q.pattern_group.patterns
-        folds = self._plan_folds(pats, index_mode=False)
-        pins = self._chain_pins(pats, folds, index_mode=False)
+        folds = self._plan_folds(pats, index_mode=index_mode)
+        pins = self._chain_pins(pats, folds, index_mode=index_mode)
         eng.dstore.pin(pins)
         try:
-            flight = []
-            for consts in consts_list:
-                B = len(consts)
-                memo_key = self._key(pats, B, "const")
-                cap_override = dict(self._cap_memo.get(memo_key, {}))
-                state = _MergeState()
-                self._init_const(state, pats, consts)
-                for k, pat, _kind, fold in self.classify(
-                        pats, folds, index_mode=False):
-                    self._dispatch(q, pat, k, state, cap_override, {}, fold)
-                counts = K.qid_counts_pos0(state.pos0(), state.n,
-                                           state.live_mask(), B=B, r=1,
-                                           slice_mode=False)
-                flight.append((counts, state.totals))
+            flight = [dispatch_one(spec, folds) for spec in specs]
             payload = [(c, [t for (_, t, _) in tot]) for c, tot in flight]
             host = jax.device_get(payload)
         finally:
             eng.dstore.unpin(pins)
         out = []
-        for (consts, (host_counts, totals), (_, tot)) in zip(
-                consts_list, host, flight):
+        for (spec, (host_counts, totals), (_, tot)) in zip(
+                specs, host, flight):
             if any(int(t) > c for (_, _, c), t in zip(tot, totals)):
-                out.append(self.run_batch_const(q, consts))  # slow path
+                out.append(slow_one(spec))  # slow path
             else:
                 out.append(np.asarray(host_counts))
         return out

@@ -205,7 +205,10 @@ class Emulator:
                     tpu.execute_batch(q0, self._draw_consts(tmpl, rng, B))
                     q0._many_warm = True
                     served = B
-            except WukongError:
+            except (WukongError, RuntimeError):
+                # RuntimeError covers XLA RESOURCE_EXHAUSTED from the
+                # window's W-fold in-flight footprint — degrade to the pool
+                # rather than aborting the run
                 q0._inst_const = None  # disables _batchable next rounds
                 return False
             self.monitor.add_latency((get_usec() - t0) / served, qtype=cls,
@@ -216,14 +219,22 @@ class Emulator:
             if q0._heavy_b == 0:
                 q0._heavy_b = min(self.proxy.tpu.suggest_index_batch(q0), 64)
             bh = q0._heavy_b
+            W = 1
+            if getattr(q0, "_many_warm", False) and self._p_cap > 1:
+                W = min(self._p_cap, 4)  # heavy tables are large; small window
             t0 = get_usec()
             try:
-                self.proxy.tpu.execute_batch_index(q0, bh)
-            except WukongError:
+                if W > 1:
+                    self.proxy.tpu.execute_batch_index_many(q0, bh, W)
+                else:
+                    self.proxy.tpu.execute_batch_index(q0, bh)
+                    q0._many_warm = True
+            except (WukongError, RuntimeError):
+                # RuntimeError: XLA OOM from the W-fold window footprint
                 q0._heavy_b = -1  # fall back to the pool for this class
                 return False
-            self.monitor.add_latency((get_usec() - t0) / bh, qtype=cls,
-                                     count=bh)
+            self.monitor.add_latency((get_usec() - t0) / (bh * W), qtype=cls,
+                                     count=bh * W)
             return True
         return False
 
