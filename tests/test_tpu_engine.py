@@ -354,3 +354,40 @@ def test_versatile_const_shapes_on_device(world):
     works = ss.str2id("<http://swat.cse.lehigh.edu/onto/univ-bench.owl#worksFor>")
     cmp([(fp, TYPE_ID, IN, -1), (-1, -9, OUT, univ0),
          (-1, works, OUT, -2)], [-1, -9, -2], "k_u_c_then_expand")
+
+
+def test_union_children_ride_device_chain(world):
+    """Seeded UNION branches route back through the TPU engine: the branch
+    plans anchor on inherited bindings (no whole-graph index start), the
+    parent table uploads once, and the branch segments stage on device."""
+    from wukong_tpu.planner.heuristic import heuristic_plan
+
+    g, ss = world
+    cpu = CPUEngine(g, ss)
+    tpu = TPUEngine(g, ss)
+    text = """PREFIX ub: <http://swat.cse.lehigh.edu/onto/univ-bench.owl#>
+    SELECT ?X ?Y ?Z WHERE {
+        ?X ub:memberOf ?Y .
+        { ?X ub:undergraduateDegreeFrom ?Z . }
+        UNION { ?X ub:mastersDegreeFrom ?Z . }
+    }"""
+    qc = Parser(ss).parse(text)
+    heuristic_plan(qc)
+    cpu.execute(qc)
+    qt = Parser(ss).parse(text)
+    heuristic_plan(qt)
+    # anchored branches plan as one k2u each, no index start prepended
+    assert all(len(u.patterns) == 1 and u.patterns[0].subject == -1
+               for u in qt.pattern_group.unions)
+    tpu.execute(qt)
+    assert qt.result.status_code == 0
+    a = sorted(map(tuple, np.asarray(qc.result.table).tolist()))
+    b = sorted(map(tuple, np.asarray(qt.result.table).tolist()))
+    assert a == b and len(a) > 0
+    ug = ss.str2id(
+        "<http://swat.cse.lehigh.edu/onto/univ-bench.owl#undergraduateDegreeFrom>")
+    ms = ss.str2id(
+        "<http://swat.cse.lehigh.edu/onto/univ-bench.owl#mastersDegreeFrom>")
+    staged = {k[:2] for k in tpu.dstore._cache if isinstance(k, tuple)}
+    assert any(k[0] == ug for k in staged)  # branch BGPs ran on device
+    assert any(k[0] == ms for k in staged)

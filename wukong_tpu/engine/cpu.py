@@ -556,9 +556,14 @@ class CPUEngine:
     # UNION (sparql.hpp:1593-1613, query.hpp:702-711 inherit_union,
     #        query.hpp:497-533 merge_result)
     # ------------------------------------------------------------------
-    def _execute_unions(self, q: SPARQLQuery) -> None:
+    def _execute_unions(self, q: SPARQLQuery, child_exec=None) -> None:
+        """UNION branches as seeded children (query.hpp:702-711
+        inherit_union). `child_exec` lets an accelerator engine route the
+        children through itself (the branch BGP then rides its chain)
+        while the merge semantics stay in one place here."""
         import copy
 
+        run = child_exec or (lambda c: self.execute(c, from_proxy=False))
         q.union_done = True
         merged: Result | None = None
         for idx, sub_pg in enumerate(q.pattern_group.unions):
@@ -569,7 +574,7 @@ class CPUEngine:
             child.result = copy.deepcopy(q.result)
             child.result.blind = False
             child.mt_factor = q.mt_factor if child.start_from_index() else 1
-            self.execute(child, from_proxy=False)
+            run(child)
             if child.result.status_code != ErrorCode.SUCCESS:
                 raise WukongError(child.result.status_code, "union child failed")
             merged = self._merge_union(merged, child.result, q.result.nvars)

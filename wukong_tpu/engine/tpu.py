@@ -110,7 +110,10 @@ class TPUEngine:
             if q.has_pattern and not q.done_patterns():
                 self._run_pattern_chain(q)
             if q.pattern_group.unions and not q.union_done:
-                self.cpu._execute_unions(q)
+                # children route back through THIS engine, so a branch BGP
+                # rides the device chain (seeded upload init) when supported
+                self.cpu._execute_unions(
+                    q, child_exec=lambda c: self.execute(c, from_proxy=False))
             if q.pattern_group.optional:
                 while q.optional_step < len(q.pattern_group.optional):
                     self.cpu._execute_optional(q)
@@ -241,6 +244,26 @@ class TPUEngine:
         import jax.numpy as jnp
 
         start, pid, d, end = pat.subject, pat.predicate, pat.direction, pat.object
+
+        if state.table is None and state.width > 0:
+            # seeded chain (UNION child over the parent's binding table):
+            # upload the host table once, then dispatch this pattern as a
+            # normal anchored step. Upload capacity is exact (row count is
+            # known), so it never participates in the overflow retry.
+            # Parent tables at union time carry no BLANKs (optionals run
+            # after unions in the state machine), so int32 is lossless.
+            host_t = q.result.table
+            n0 = len(host_t)
+            assert_ec(n0 <= self.cap_max, ErrorCode.UNKNOWN_PATTERN,
+                      f"seed table ({n0:,} rows) exceeds "
+                      f"table_capacity_max ({self.cap_max:,})")
+            cap = K.next_capacity(max(n0, 1), self.cap_min, self.cap_max)
+            pad = np.zeros((state.width, cap), dtype=np.int32)
+            if host_t.size:
+                pad[:, :n0] = host_t.T
+            state.table = jnp.asarray(pad)
+            state.n = jnp.int32(n0)
+            state.est_rows = max(n0, 1)
 
         if state.table is None:
             if q.start_from_index() and step == q.pattern_step == 0 \
@@ -689,8 +712,10 @@ class TPUEngine:
                 return probe.col_of(pat.object) is None
             return True  # const object: expand2 + equality fold
         if is_first and q.pattern_step == 0 and q.start_from_index():
-            # index_to_known is host-only (like the reference GPU engine)
-            return probe.col_of(pat.object) is None
+            # index_to_known is host-only (like the reference GPU engine),
+            # and a seeded (width > 0) table cannot consume an index start —
+            # the host kernel raises FIRST_PATTERN_ERROR (CPU parity)
+            return probe.width == 0 and probe.col_of(pat.object) is None
         s_known = pat.subject > 0 or probe.col_of(pat.subject) is not None
         if is_first and probe.width == 0:
             return pat.subject > 0  # const start

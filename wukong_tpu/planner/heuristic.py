@@ -15,20 +15,43 @@ from wukong_tpu.types import IN, NORMAL_ID_START, OUT, PREDICATE_ID, TYPE_ID, is
 from wukong_tpu.utils.errors import ErrorCode, WukongError
 
 
+def bound_vars(pg: PatternGroup) -> set:
+    """Variables bound once a group's patterns have executed."""
+    return {v for p in pg.patterns
+            for v in (p.subject, p.predicate, p.object) if v < 0}
+
+
+def plan_seeded_group(pg: PatternGroup, seed_known: set) -> bool:
+    """Plan a UNION branch against inherited bindings (inherit_union,
+    query.hpp:702-711). True if the branch anchors on a seeded var in
+    subject/object position (planned in place, starting from that binding
+    instead of a whole-graph index scan); False for disjoint branches —
+    the caller plans those independently. THE single anchorability test:
+    predicate-position sharing alone never anchors a chain."""
+    anchored = any((p.subject < 0 and p.subject in seed_known)
+                   or (p.object < 0 and p.object in seed_known)
+                   for p in pg.patterns)
+    if anchored:
+        _plan_group(pg, seed_known=seed_known)
+    return anchored
+
+
 def heuristic_plan(q: SPARQLQuery) -> None:
     _plan_group(q.pattern_group)
+    parent_bound = bound_vars(q.pattern_group)
     for u in q.pattern_group.unions:
-        _plan_group(u)
+        if not plan_seeded_group(u, parent_bound):
+            _plan_group(u)
     # OPTIONAL groups are reordered at execution time against the bound result
     # (query.hpp reorder_optional_patterns), not planned here.
 
 
-def _plan_group(pg: PatternGroup) -> None:
+def _plan_group(pg: PatternGroup, seed_known: set | None = None) -> None:
     if not pg.patterns:
         return
     remaining = list(pg.patterns)
     planned: list[Pattern] = []
-    known: set[int] = set()
+    known: set[int] = set(seed_known or ())
 
     def bindable(p: Pattern):
         """Orientation score for executing p next; higher is better.
@@ -49,12 +72,35 @@ def _plan_group(pg: PatternGroup) -> None:
         return 3 if (s_bound and o_bound) else 1
 
     # choose the start pattern: const start > type pattern > predicate index
-    first = None
-    for p in remaining:
-        if (0 < p.subject and not is_tpid(p.subject)) or \
-           (0 < p.object and not is_tpid(p.object) and p.object >= NORMAL_ID_START):
-            first = p
-            break
+    if known and any(bindable(p) is not None for p in remaining):
+        # a seeded group (UNION branch) anchors on an inherited binding;
+        # no start pattern needed — the greedy loop below orders everything
+        first = None
+    else:
+        first = None
+        for p in remaining:
+            if (0 < p.subject and not is_tpid(p.subject)) or \
+               (0 < p.object and not is_tpid(p.object)
+                    and p.object >= NORMAL_ID_START):
+                first = p
+                break
+        if first is None:
+            # type-index start on a type pattern, else predicate-index start
+            tpat = next((p for p in remaining
+                         if p.predicate == TYPE_ID and is_tpid(p.object)),
+                        None)
+            if tpat is not None:
+                remaining.remove(tpat)
+                planned.append(Pattern(tpat.object, TYPE_ID, IN, tpat.subject))
+            else:
+                p0 = next((p for p in remaining if p.predicate > 1), None)
+                if p0 is None:
+                    raise WukongError(ErrorCode.UNKNOWN_PLAN,
+                                      "no plannable start pattern")
+                # predicate-index start: bind the subject side, keep the
+                # pattern
+                planned.append(
+                    Pattern(p0.predicate, PREDICATE_ID, IN, p0.subject))
     if first is not None:
         remaining.remove(first)
         if first.subject > 0 and first.subject >= NORMAL_ID_START:
@@ -63,20 +109,6 @@ def _plan_group(pg: PatternGroup) -> None:
         else:  # const object: flip
             planned.append(Pattern(first.object, first.predicate, IN,
                                    first.subject, first.pred_type))
-    else:
-        # type-index start on a type pattern, else predicate-index start
-        tpat = next((p for p in remaining
-                     if p.predicate == TYPE_ID and is_tpid(p.object)), None)
-        if tpat is not None:
-            remaining.remove(tpat)
-            planned.append(Pattern(tpat.object, TYPE_ID, IN, tpat.subject))
-        else:
-            p0 = next((p for p in remaining if p.predicate > 1), None)
-            if p0 is None:
-                raise WukongError(ErrorCode.UNKNOWN_PLAN,
-                                  "no plannable start pattern")
-            # predicate-index start: bind the subject side, keep the pattern
-            planned.append(Pattern(p0.predicate, PREDICATE_ID, IN, p0.subject))
     for p in planned:
         _note_known(p, known)
 

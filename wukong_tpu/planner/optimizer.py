@@ -103,10 +103,17 @@ class Planner:
         q.planner_empty = bool(self._best_state is not None
                                and self._best_state.empty
                                and not pg.unions)
+        from wukong_tpu.planner.heuristic import bound_vars, plan_seeded_group
+
+        parent_bound = bound_vars(pg)
         for u in pg.unions:
-            sub = SPARQLQuery()
-            sub.pattern_group = u
-            self.generate_plan(sub)
+            # anchored branches execute seeded with the parent table, so
+            # they order from those bindings; disjoint branches get their
+            # own cost-based plan
+            if not plan_seeded_group(u, parent_bound):
+                sub = SPARQLQuery()
+                sub.pattern_group = u
+                self.generate_plan(sub)
         return True
 
     # ------------------------------------------------------------------
