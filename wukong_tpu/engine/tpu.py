@@ -115,8 +115,36 @@ class TPUEngine:
                 self.cpu._execute_unions(
                     q, child_exec=lambda c: self.execute(c, from_proxy=False))
             if q.pattern_group.optional:
+                from wukong_tpu.engine.optional_join import (
+                    execute_optional_leftjoin,
+                )
+
                 while q.optional_step < len(q.pattern_group.optional):
-                    self.cpu._execute_optional(q)
+                    group = q.pattern_group.optional[q.optional_step]
+                    shares = any(
+                        v < 0 and q.result.var2col(v) != NO_RESULT
+                        for p in group.patterns
+                        for v in (p.subject, p.object))
+                    # a parent-bound PREDICATE var has no seeded-child
+                    # kernel (the child would re-solve it unconstrained) —
+                    # the in-place host formulation handles that shape
+                    pred_bound = any(
+                        p.predicate < 0
+                        and q.result.var2col(p.predicate) != NO_RESULT
+                        for p in group.patterns)
+                    if q.result.attr_col_num == 0 and shares \
+                            and not pred_bound:
+                        # dedup-seeded child + host left join: the group's
+                        # BGP rides the device chain (seeded upload init)
+                        execute_optional_leftjoin(
+                            q, self.cpu,
+                            run_child=lambda c: self.execute(
+                                c, from_proxy=False),
+                            str_server=self.str_server)
+                    else:
+                        # no shared binding (e.g. optional-only queries) or
+                        # attr columns: the in-place host formulation
+                        self.cpu._execute_optional(q)
             if q.pattern_group.filters:
                 self.cpu._execute_filters(q)
             if from_proxy:

@@ -230,83 +230,14 @@ class DistEngine:
         q.result.set_table(merged.table)
 
     def _execute_optional_dist(self, q: SPARQLQuery) -> None:
-        """OPTIONAL as a dedup-seeded distributed child + host left join.
+        """OPTIONAL as a dedup-seeded distributed child + host left join
+        (the shared engine-agnostic formulation, engine/optional_join.py)."""
+        from wukong_tpu.engine.optional_join import execute_optional_leftjoin
 
-        The reference masks rows in place (optional_matched_rows,
-        query.hpp:782-813); a left join over the shared bound variables is
-        the same relation: parent rows extend by every child match, rows
-        with no match survive with BLANK_ID in the group's new columns."""
-        import copy
-
-        from wukong_tpu.sparql.ir import NO_RESULT as NR
-        from wukong_tpu.types import BLANK_ID
-
-        group = q.pattern_group.optional[q.optional_step]
-        q.optional_step += 1
-        res = q.result
-        assert_ec(res.attr_col_num == 0, ErrorCode.UNSUPPORTED_SHAPE,
-                  "OPTIONAL after attribute patterns is unsupported "
-                  "in the distributed engine")
-        pg = copy.deepcopy(group)
-        host = self._host()
-        host._count_optional_new_vars(pg, res)
-        host._reorder_optional_patterns(pg, res)
-        # the reference evaluates an OPTIONAL group's FILTERs on the child's
-        # MERGED table (the child query re-enters the state machine with the
-        # parent rows, cpu.py _execute_optional) — a failing filter drops the
-        # whole row, matched or BLANK. So filters run after the join here.
-        deferred_filters = pg.filters
-        pg.filters = []
-
-        # join keys = parent-bound vars used by the group's PATTERNS; the
-        # deferred filters see every parent column on the joined table, so
-        # filter-only vars never need seeding
-        used = {v for p in pg.patterns for v in (p.subject, p.object) if v < 0}
-        shared = sorted({v for v in used if res.var2col(v) != NR},
-                        reverse=True)
-        assert_ec(len(shared) > 0, ErrorCode.UNSUPPORTED_SHAPE,
-                  "OPTIONAL group shares no bound variable with its parent")
-        pcols = [res.var2col(v) for v in shared]
-        seeds = (np.unique(res.table[:, pcols], axis=0)
-                 if res.table.size else np.empty((0, len(pcols)), np.int64))
-
-        child = SPARQLQuery()
-        child.pqid = q.qid
-        child.pattern_group = pg
-        child.result.nvars = res.nvars
-        child.result.set_table(seeds.astype(np.int64))
-        child.result.col_num = len(pcols)
-        for i, v in enumerate(shared):
-            child.result.add_var2col(v, i)
-        child.result.blind = False
-        self._execute_sm(child, from_proxy=False)
-        if child.result.status_code != ErrorCode.SUCCESS:
-            raise WukongError(child.result.status_code, "optional child failed")
-
-        cres = child.result
-        ckey = [cres.var2col(v) for v in shared]
-        new_vars = [v for v, c in sorted(cres.v2c_map.items(),
-                                         key=lambda kv: kv[1])
-                    if v not in shared and c != NR]
-        cnew = [cres.var2col(v) for v in new_vars]
-        row_idx, new_cols = _left_join(
-            res.table[:, pcols] if res.table.size
-            else np.empty((res.nrows, len(pcols)), np.int64),
-            cres.table, ckey, cnew, blank=BLANK_ID)
-        base = (res.table[row_idx] if res.table.size
-                else np.empty((len(row_idx), res.col_num), np.int64))
-        w0 = res.col_num
-        res.set_table(np.column_stack([base, new_cols])
-                      if new_cols.shape[1] else base)  # updates col_num
-        for j, v in enumerate(new_vars):
-            res.add_var2col(v, w0 + j)
-        if deferred_filters:
-            assert_ec(self.str_server is not None, ErrorCode.UNKNOWN_FILTER,
-                      "FILTER needs a string server")
-            fq = SPARQLQuery()
-            fq.pattern_group.filters = deferred_filters
-            fq.result = res
-            host._execute_filters(fq)
+        execute_optional_leftjoin(
+            q, self._host(),
+            run_child=lambda c: self._execute_sm(c, from_proxy=False),
+            str_server=self.str_server)
 
     # ------------------------------------------------------------------
     def _run_device_bgp(self, q: SPARQLQuery, n_steps: int, seed=None) -> None:
@@ -853,40 +784,6 @@ class _ShardedAttrGraph:
 
         return self.stores[int(hash_mod(int(vid), self.D))].get_attr(
             vid, aid, d)
-
-
-def _left_join(parent_keys: np.ndarray, child_table: np.ndarray,
-               ckey_cols: list, cnew_cols: list, blank: int):
-    """Left join on key columns: each parent key row expands by all child
-    rows with an equal key; keyless parents emit one row with `blank` in the
-    new columns. Returns (row_idx into parent, new_cols [L, len(cnew_cols)]).
-    """
-    from wukong_tpu.engine.cpu import _expand_rows
-
-    N, Kw = parent_keys.shape
-    M = len(child_table)
-    if M == 0:
-        return (np.arange(N, dtype=np.int64),
-                np.full((N, len(cnew_cols)), blank, dtype=np.int64))
-    dt = np.dtype([(f"f{i}", np.int64) for i in range(Kw)])
-    ck = np.ascontiguousarray(
-        child_table[:, ckey_cols].astype(np.int64)).view(dt).reshape(-1)
-    order = np.argsort(ck)
-    ck_s = ck[order]
-    cnew_s = (child_table[order][:, cnew_cols].astype(np.int64)
-              if cnew_cols else np.empty((M, 0), np.int64))
-    uniq, starts, cnts = np.unique(ck_s, return_index=True, return_counts=True)
-    pk = np.ascontiguousarray(parent_keys.astype(np.int64)).view(dt).reshape(-1)
-    gi = np.searchsorted(uniq, pk)
-    gi_c = np.clip(gi, 0, len(uniq) - 1)
-    matched = uniq[gi_c] == pk
-    mcount = np.where(matched, cnts[gi_c], 1)
-    row_idx, local = _expand_rows(mcount)
-    out = np.full((len(row_idx), len(cnew_cols)), blank, dtype=np.int64)
-    is_m = matched[row_idx]
-    if cnew_cols and is_m.any():
-        out[is_m] = cnew_s[starts[gi_c[row_idx[is_m]]] + local[is_m]]
-    return row_idx, out
 
 
 # ---------------------------------------------------------------------------

@@ -199,6 +199,17 @@ class Parser:
 
         q = SPARQLQuery()
         q.pattern_group = self._resolve_group(group)
+        pg = q.pattern_group
+        if not pg.patterns and not pg.unions and pg.optional:
+            # a leading OPTIONAL with no required patterns IS the base
+            # (optional/q5): the reference's planner promotes the first
+            # group to the start — LeftJoin(Unit, A) = A whenever A has
+            # solutions, and both formulations yield zero rows otherwise
+            first = pg.optional.pop(0)
+            pg.patterns = first.patterns
+            pg.filters = first.filters + pg.filters
+            pg.unions = first.unions
+            pg.optional = first.optional + pg.optional
         q.distinct = distinct or reduced
         q.limit = limit
         q.offset = offset
