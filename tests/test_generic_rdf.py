@@ -9,7 +9,7 @@ from wukong_tpu.engine.tpu import TPUEngine
 from wukong_tpu.loader.generic_rdf import generate_generic
 from wukong_tpu.planner.optimizer import Planner
 from wukong_tpu.planner.stats import Stats
-from wukong_tpu.sparql.ir import Pattern, SPARQLQuery
+from wukong_tpu.sparql.ir import NO_RESULT, Pattern, PatternGroup, SPARQLQuery
 from wukong_tpu.store.checker import check_cross_partition, check_partition
 from wukong_tpu.store.gstore import build_all_partitions, build_partition
 from wukong_tpu.types import IN, OUT, TYPE_ID
@@ -230,3 +230,86 @@ def test_fuzz_versatile_shapes_all_engines(world, seed, eight_cpu_devices):
             got = sorted(
                 map(tuple, np.asarray(q.result.table)[:, cols].tolist()))
             assert got == want, f"{name} diverged on {raw}"
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_fuzz_union_optional_all_engines(world, seed, eight_cpu_devices):
+    """Differential fuzz over UNION/OPTIONAL composition: random anchored
+    parents with random seeded branches/groups must agree across the CPU
+    (in-place masking), TPU (seeded device children + left join) and
+    distributed (shard_map children + left join) engines — three
+    independent formulations of the same relation."""
+    triples, meta, g, stats = world
+    rng = np.random.default_rng(3000 + seed)
+    cpu = CPUEngine(g, None)
+    tpu = TPUEngine(g, None, stats=stats)
+    dist = _fuzz_dist(triples)
+    pids = [int(p) for p in np.unique(triples[:, 1]) if p != TYPE_ID]
+    norm = triples[triples[:, 1] != TYPE_ID]
+
+    def rand_case():
+        # var ids must be CONTIGUOUS -1..-n (the parser convention the
+        # engines' union merge iterates over)
+        row = norm[rng.integers(0, len(norm))]
+        pats = [(int(row[0]), int(row[1]), -1)]
+        bound = [-1]
+        nxt = -2
+        if rng.random() < 0.5:  # optional second parent hop
+            pats.append((-1, int(rng.choice(pids)), nxt))
+            bound.append(nxt)
+            nxt -= 1
+        unions, optionals = [], []
+        n_u = int(rng.integers(0, 3))
+        if n_u:  # anchored 1-pattern branches binding ONE shared var
+            v = nxt
+            nxt -= 1
+            for _ in range(n_u):
+                a = int(rng.choice(bound))
+                unions.append([(a, int(rng.choice(pids)), v)])
+        for _ in range(int(rng.integers(0, 3))):  # optional groups
+            a = int(rng.choice(bound))
+            grp = [(a, int(rng.choice(pids)), nxt)]
+            nxt -= 1
+            if rng.random() < 0.4:  # 2-hop group
+                grp.append((grp[0][2], int(rng.choice(pids)), nxt))
+                nxt -= 1
+            optionals.append(grp)
+        return pats, unions, optionals
+
+    for _ in range(3):
+        pats, unions, optionals = rand_case()
+        all_vars = sorted({v for src in ([pats] + unions + optionals)
+                           for p in src for v in p if v < 0}, reverse=True)
+
+        def mk():
+            q = SPARQLQuery()
+            q.result.nvars = len(all_vars)
+            q.pattern_group.patterns = [Pattern(s, p, OUT, o)
+                                        for (s, p, o) in pats]
+            for b in unions:
+                u = PatternGroup()
+                u.patterns = [Pattern(s, p, OUT, o) for (s, p, o) in b]
+                q.pattern_group.unions.append(u)
+            for grp in optionals:
+                og = PatternGroup()
+                og.patterns = [Pattern(s, p, OUT, o) for (s, p, o) in grp]
+                q.pattern_group.optional.append(og)
+            q.result.required_vars = list(all_vars)
+            return q
+
+        outs = {}
+        for name, eng in (("cpu", cpu), ("tpu", tpu), ("dist", dist)):
+            q = mk()
+            eng.execute(q, from_proxy=False)
+            assert q.result.status_code == 0, \
+                (name, pats, unions, optionals, q.result.status_code)
+            cols = [q.result.var2col(v) for v in all_vars]
+            assert all(c != NO_RESULT for c in cols), (name, cols)
+            outs[name] = sorted(
+                map(tuple, np.asarray(q.result.table)[:, cols].tolist()))
+        assert outs["tpu"] == outs["cpu"], \
+            ("tpu", pats, unions, optionals,
+             len(outs["tpu"]), len(outs["cpu"]))
+        assert outs["dist"] == outs["cpu"], \
+            ("dist", pats, unions, optionals,
+             len(outs["dist"]), len(outs["cpu"]))
