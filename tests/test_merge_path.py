@@ -188,8 +188,9 @@ def test_merge_forced_compaction_matches(engines, world, monkeypatch):
 def test_stream_expand_in_executor(engines, world, qfile, monkeypatch):
     """Force the Pallas streaming expand (interpret mode) through the whole
     merge executor: counts must match the oracle for every benchmark query.
-    Slice mode keeps step-1 anchors distinct (stream path proper); replicate
-    mode duplicates them (exercises the in-cond XLA fallback)."""
+    Slice mode keeps step-1 anchors distinct (pure stream arm); replicate
+    mode duplicates them uniformly B times (B <= MDUP exercises the m-hot
+    arm, beyond it the in-cond XLA fallback)."""
     from wukong_tpu.engine import tpu_stream
 
     cpu, tpu = engines
@@ -209,9 +210,14 @@ def test_stream_expand_in_executor(engines, world, qfile, monkeypatch):
     if q.start_from_index():
         counts = tpu.execute_batch_index(q, 2, slice_mode=True)
         assert int(counts.sum()) == want
+        from wukong_tpu.engine.tpu_stream import MDUP
+
         q2 = _parse(ss, qfile)
-        counts = tpu.execute_batch_index(q2, 2)  # replicate: dup fallback
-        assert counts.tolist() == [want] * 2
+        counts = tpu.execute_batch_index(q2, MDUP)  # m-hot at the exact cap
+        assert counts.tolist() == [want] * MDUP
+        q3 = _parse(ss, qfile)
+        counts = tpu.execute_batch_index(q3, MDUP + 2)  # beyond: XLA arm
+        assert counts.tolist() == [want] * (MDUP + 2)
     else:
         const = q.pattern_group.patterns[0].subject
         counts = tpu.execute_batch(q, np.full(2, const, dtype=np.int64))

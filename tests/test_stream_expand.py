@@ -67,7 +67,34 @@ def test_stream_matches_merge_distinct_anchors(seed):
     assert int(at) > 0  # the case actually expanded something
 
 
-def test_stream_duplicate_anchors_fallback():
+def _multiset(v, p, n):
+    return sorted(zip(v[:n].tolist(), p[:n].tolist()))
+
+
+def test_stream_duplicate_anchors_mhot():
+    """Multiplicity <= MDUP streams through the m-hot arm: same (val,
+    parent) BAG as the XLA emit (edge-repeat vs run-repeat order)."""
+    rng = np.random.default_rng(7)
+    sk, ss, sd, e, keys, offs = _mk_segment(rng, nkeys=64, max_deg=5)
+    C = 256
+    picks = rng.choice(keys, size=30, replace=False)
+    reps = rng.integers(1, 5, size=30)  # multiplicities 1..4
+    anchors = np.repeat(picks, reps)
+    n = len(anchors)
+    cur = np.full(C, INT32_MAX, np.int32)
+    cur[:n] = anchors
+    live = np.ones(C, bool)
+    (av, ap, an, at), (bv, bp, bn, bt) = _run_both(
+        sk, ss, sd, e, cur, n, live, cap=1 << 12)
+    assert int(at) == int(bt) and int(an) == int(bn)
+    assert int(at) > 0
+    assert _multiset(av, ap, an) == _multiset(bv, bp, bn)
+
+
+def test_stream_duplicate_anchors_mhot_off_bitwise():
+    """mhot=False restores the XLA fallback: bit-identical on duplicates."""
+    from wukong_tpu.engine.tpu_stream import stream_expand as se
+
     rng = np.random.default_rng(7)
     sk, ss, sd, e, keys, offs = _mk_segment(rng, nkeys=64, max_deg=5)
     C = 256
@@ -76,11 +103,66 @@ def test_stream_duplicate_anchors_fallback():
     cur[:n] = rng.choice(keys, size=n, replace=True)  # repeats guaranteed
     cur[1] = cur[0]
     live = np.ones(C, bool)
+    a = merge_expand(jnp.asarray(sk), jnp.asarray(ss), jnp.asarray(sd),
+                     jnp.asarray(e), jnp.asarray(cur), jnp.int32(n),
+                     jnp.asarray(live), cap_out=1 << 12)
+    b = se(jnp.asarray(sk), jnp.asarray(ss), jnp.asarray(sd),
+           jnp.asarray(e), jnp.asarray(cur), jnp.int32(n),
+           jnp.asarray(live), cap_out=1 << 12, interpret=True, mhot=False)
+    for x, y in zip(a, b):
+        assert np.array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_stream_high_multiplicity_falls_back_bitwise():
+    """Multiplicity > MDUP takes the XLA arm: bit-identical again."""
+    from wukong_tpu.engine.tpu_stream import MDUP
+
+    rng = np.random.default_rng(9)
+    sk, ss, sd, e, keys, offs = _mk_segment(rng, nkeys=64, max_deg=5)
+    C = 256
+    cur = np.full(C, INT32_MAX, np.int32)
+    hot = keys[np.argmax(sd[:64])]
+    n = MDUP + 8
+    cur[:n] = hot  # one key far beyond the m-hot cap
+    live = np.ones(C, bool)
     (av, ap, an, at), (bv, bp, bn, bt) = _run_both(
         sk, ss, sd, e, cur, n, live, cap=1 << 12)
     assert int(at) == int(bt) and int(an) == int(bn)
     assert np.array_equal(av, bv)
     assert np.array_equal(ap, bp)
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_stream_mhot_fuzz(seed):
+    """Randomized duplicate-anchor frontiers (mixed multiplicities 1..MDUP,
+    hub degrees, partial live masks, both compaction backends): the m-hot
+    bag must equal the XLA emit's bag, totals identical."""
+    rng = np.random.default_rng(500 + seed)
+    nkeys = int(rng.integers(16, 400))
+    max_deg = int(rng.integers(1, 20))
+    sk, ss, sd, e, keys, offs = _mk_segment(rng, nkeys=nkeys, max_deg=max_deg)
+    C = int(rng.choice([256, 1024]))
+    npick = int(rng.integers(1, min(C // 4, nkeys) + 1))
+    picks = rng.choice(keys, size=npick, replace=False)
+    reps = rng.integers(1, 5, size=npick)
+    anchors = np.repeat(picks, reps)[: C - 1]
+    rng.shuffle(anchors)  # duplicates need not be row-adjacent
+    n = len(anchors)
+    cur = np.full(C, INT32_MAX, np.int32)
+    cur[:n] = anchors
+    live = rng.random(C) > rng.random() * 0.4
+    mxu = bool(rng.integers(0, 2))
+    a = merge_expand(jnp.asarray(sk), jnp.asarray(ss), jnp.asarray(sd),
+                     jnp.asarray(e), jnp.asarray(cur), jnp.int32(n),
+                     jnp.asarray(live), cap_out=1 << 13)
+    b = stream_expand(jnp.asarray(sk), jnp.asarray(ss), jnp.asarray(sd),
+                      jnp.asarray(e), jnp.asarray(cur), jnp.int32(n),
+                      jnp.asarray(live), cap_out=1 << 13, interpret=True,
+                      mxu=mxu)
+    av, ap, an, at = [np.asarray(x) for x in a]
+    bv, bp, bn, bt = [np.asarray(x) for x in b]
+    assert int(at) == int(bt) and int(an) == int(bn)
+    assert _multiset(av, ap, int(an)) == _multiset(bv, bp, int(bn))
 
 
 def test_stream_empty_and_all_miss():

@@ -530,12 +530,14 @@ class MergeExecutor:
             if tpu_stream.want_stream(est, int(seg.edges.shape[0]), cap_out):
                 # dense expansion: stream the edge array through VMEM
                 # (~3 ns/edge) instead of the per-output scatter+gather
-                # (~25 ns/out); lax.cond inside falls back to the XLA emit
-                # when the frontier has duplicate anchors
+                # (~25 ns/out); duplicate-anchor frontiers stream through
+                # the m-hot arm up to multiplicity MDUP, beyond that a
+                # device-side lax.cond falls back to the XLA emit
                 vals, parent, n, total = tpu_stream.stream_expand(
                     seg.skey, seg.sstart, seg.sdeg, seg.edges, cur, state.n,
                     state.live_mask(), cap_out=cap_out,
-                    interpret=tpu_stream.FORCE_INTERPRET)
+                    interpret=tpu_stream.FORCE_INTERPRET,
+                    mhot=tpu_stream.mhot_enabled())
             else:
                 vals, parent, n, total = K.merge_expand(
                     seg.skey, seg.sstart, seg.sdeg, seg.edges, cur, state.n,

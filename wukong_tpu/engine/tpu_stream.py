@@ -23,10 +23,16 @@ row and a few VPU ops — ~3 ns/edge, vs ~25 ns/output for the XLA path, a
 win whenever the expansion is dense in the segment (heavy index-origin
 chains are exactly that; the host gates on estimated density).
 
-Duplicate anchors (two frontier rows with one key) would make runs overlap,
-which delta-integration cannot represent; a device-side `lax.cond` falls
-back to the XLA emit in that case — no mid-chain host sync, both emits are
-branch arms of one compiled program.
+Duplicate anchors (two frontier rows with one key) make runs overlap, which
+plain 0/1 delta-integration cannot represent. The m-hot arm handles
+multiplicity up to MDUP: dsel's `.add` boundaries already accumulate a
+per-edge multiplicity m(e), the selection plane becomes an interval test
+(each edge owns m(e) consecutive output rows — edge-repeat order, a
+permutation of the XLA emit's run-repeat order), and parents are emitted as
+rank positions (dupstart + copy index, integrated from a third delta
+channel) that one XLA gather resolves afterwards. Beyond MDUP a device-side
+`lax.cond` falls back to the XLA emit — no mid-chain host sync, all emits
+are branch arms of one compiled program.
 
 All intra-kernel prefix sums are triangular-ONES matmuls (MXU) rather than
 `cumsum`, because matmul is the one primitive guaranteed to lower in
@@ -61,20 +67,22 @@ FORCE_INTERPRET = False
 # the MXU variant first and flips to VPU if it fails to lower.
 USE_MXU_COMPACT = True
 
-_stream_state = {"ok": None}
+_stream_state = {"ok": None, "mhot": True}
 
 
 def stream_available() -> bool:
     """One-time capability probe: compile + run a tiny stream_expand on the
     current backend (exercises the grid, SMEM carries, triangular matmuls,
-    accumulator flush DMAs). Any failure permanently selects the XLA path."""
+    accumulator flush DMAs) and, when enabled, the m-hot duplicate-anchor
+    arm. Preference order: (mxu, mhot) > (vpu, mhot) > (mxu, no-mhot) >
+    (vpu, no-mhot); total failure permanently selects the XLA path."""
     global USE_MXU_COMPACT
     if _stream_state["ok"] is None:
         if jax.devices()[0].platform != "tpu":
             _stream_state["ok"] = False
             return False
 
-        def _probe(mxu: bool) -> bool:
+        def _probe(mxu: bool, mhot: bool) -> bool:
             # edge values near INT32_MAX with odd low bits: a backend that
             # lowers the compaction dot but truncates fp32 inputs (bf16
             # passes) would corrupt exactly these, so the probe must use
@@ -89,22 +97,50 @@ def stream_available() -> bool:
             live = jnp.ones(8, bool)
             v, p, n, t = stream_expand(skey, sstart, sdeg, edges, cur,
                                        jnp.int32(6), live, cap_out=1024,
-                                       mxu=mxu)
-            return bool(int(n) == 2 and int(v[0]) == big
-                        and int(v[1]) == 65_537 and int(p[0]) == 5
-                        and int(p[1]) == 5)
+                                       mxu=mxu, mhot=mhot)
+            if not (int(n) == 2 and int(v[0]) == big
+                    and int(v[1]) == 65_537 and int(p[0]) == 5
+                    and int(p[1]) == 5):
+                return False
+            if mhot:
+                # duplicate anchors (multiplicity 2) through the m-hot arm:
+                # rows 1 and 5 both anchor key 3 — expect each edge twice
+                # with both parents (edge-repeat order)
+                cur2 = cur.at[1].set(3)
+                v, p, n, t = stream_expand(skey, sstart, sdeg, edges, cur2,
+                                           jnp.int32(6), live, cap_out=1024,
+                                           mxu=mxu, mhot=True)
+                got = sorted((int(v[i]), int(p[i])) for i in range(int(n)))
+                want = sorted([(big, 1), (big, 5), (65_537, 1), (65_537, 5)])
+                return int(t) == 4 and got == want
+            return True
 
         ok = False
-        for mxu in ((True, False) if USE_MXU_COMPACT else (False,)):
-            try:
-                if _probe(mxu):
-                    USE_MXU_COMPACT = mxu
-                    ok = True
-                    break
-            except Exception:
-                continue
+        mxu_opts = (True, False) if USE_MXU_COMPACT else (False,)
+        for mhot in (True, False):
+            for mxu in mxu_opts:
+                try:
+                    if _probe(mxu, mhot):
+                        USE_MXU_COMPACT = mxu
+                        _stream_state["mhot"] = mhot
+                        ok = True
+                        break
+                except Exception:
+                    continue
+            if ok:
+                break
         _stream_state["ok"] = ok
     return _stream_state["ok"]
+
+
+def mhot_enabled() -> bool:
+    """Whether the duplicate-anchor m-hot arm is active (probe result +
+    the WUKONG_ENABLE_STREAM_MHOT A/B toggle)."""
+    import os
+
+    if os.environ.get("WUKONG_ENABLE_STREAM_MHOT", "1") == "0":
+        return False
+    return _stream_state["mhot"]
 
 
 def want_stream(est_out: float, num_edges: int, cap_out: int) -> bool:
@@ -172,6 +208,44 @@ def _psum_i32(x2, incl: bool):
 # ---------------------------------------------------------------------------
 # the kernel
 # ---------------------------------------------------------------------------
+
+
+def _dma_ring(stage_a, stage_b, out_a, out_b, sems, carry, cap_pad: int):
+    """Double-buffered aligned-block flush helpers shared by both emit
+    kernels. Capacity overflow skips the DMA but still counts blocks, so
+    waits are flag-guarded ([6+slot]), never inferred from block math."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    T = TILE
+
+    def wait_slot(slot):
+        @pl.when(carry[6 + slot] == 1)
+        def _():
+            blk_idx = carry[4 + slot]
+            pltpu.make_async_copy(
+                stage_a.at[slot], out_a.at[pl.ds(blk_idx * T, T), :],
+                sems.at[slot, 0]).wait()
+            pltpu.make_async_copy(
+                stage_b.at[slot], out_b.at[pl.ds(blk_idx * T, T), :],
+                sems.at[slot, 1]).wait()
+            carry[6 + slot] = 0
+
+    def start_block(blk, slot, src_a, src_b):
+        @pl.when((blk + 1) * T <= cap_pad)
+        def _():
+            stage_a[slot] = src_a
+            stage_b[slot] = src_b
+            pltpu.make_async_copy(
+                stage_a.at[slot], out_a.at[pl.ds(blk * T, T), :],
+                sems.at[slot, 0]).start()
+            pltpu.make_async_copy(
+                stage_b.at[slot], out_b.at[pl.ds(blk * T, T), :],
+                sems.at[slot, 1]).start()
+            carry[4 + slot] = blk
+            carry[6 + slot] = 1
+
+    return wait_slot, start_block
 
 
 def _emit_kernel(edges_ref, dsel_ref, dpar_ref,
@@ -243,42 +317,15 @@ def _emit_kernel(edges_ref, dsel_ref, dpar_ref,
         acc_par[...] = acc_par[...] + jnp.sum(
             jnp.where(m2, par_r, 0), axis=1, keepdims=True)
     fnew = f + count
-
-    def _wait_slot(slot):
-        @pl.when(carry[6 + slot] == 1)
-        def _():
-            blk_idx = carry[4 + slot]
-            pltpu.make_async_copy(
-                stage_val.at[slot],
-                val_out.at[pl.ds(blk_idx * T, T), :],
-                sems.at[slot, 0]).wait()
-            pltpu.make_async_copy(
-                stage_par.at[slot],
-                par_out.at[pl.ds(blk_idx * T, T), :],
-                sems.at[slot, 1]).wait()
-            carry[6 + slot] = 0
-
-    def _start_block(blk, slot):
-        # flush only while in capacity; overflow still counts (host retry)
-        @pl.when((blk + 1) * T <= cap_pad)
-        def _():
-            stage_val[slot] = acc_val[0:T]
-            stage_par[slot] = acc_par[0:T]
-            pltpu.make_async_copy(
-                stage_val.at[slot],
-                val_out.at[pl.ds(blk * T, T), :], sems.at[slot, 0]).start()
-            pltpu.make_async_copy(
-                stage_par.at[slot],
-                par_out.at[pl.ds(blk * T, T), :], sems.at[slot, 1]).start()
-            carry[4 + slot] = blk
-            carry[6 + slot] = 1
+    _wait_slot, _start_block = _dma_ring(stage_val, stage_par, val_out,
+                                         par_out, sems, carry, cap_pad)
 
     @pl.when(fnew >= T)
     def _flush():
         blk = carry[3]
         slot = blk % 2
         _wait_slot(slot)  # free the staging slot before overwriting it
-        _start_block(blk, slot)
+        _start_block(blk, slot, acc_val[0:T], acc_par[0:T])
         # shift the accumulator down one block
         acc_val[0:T] = acc_val[T:2 * T]
         acc_par[0:T] = acc_par[T:2 * T]
@@ -297,7 +344,7 @@ def _emit_kernel(edges_ref, dsel_ref, dpar_ref,
         # final partial block (aligned, disjoint from all flushed blocks)
         slot = blk % 2
         _wait_slot(slot)
-        _start_block(blk, slot)
+        _start_block(blk, slot, acc_val[0:T], acc_par[0:T])
         _wait_slot(slot)
         _wait_slot(1 - slot)  # drain any DMA still in flight
         total_out[0, 0] = blk * T + f_end
@@ -346,18 +393,188 @@ def _stream_emit(edges2, dsel2, dpar2, cap_out: int, interpret: bool = False,
 
 
 # ---------------------------------------------------------------------------
+# m-hot variant: duplicate-anchor frontiers with multiplicity <= MDUP
+# ---------------------------------------------------------------------------
+
+MDUP = 4  # static multiplicity cap for the m-hot arm (plane height scales)
+
+_ROW_OFF = 1 << 18  # keeps the q payload non-negative for the halves trick
+
+
+def _emit_kernel_m(edges_ref, dsel_ref, drow_ref,
+                   val_out, row_out, total_out,
+                   stage_val, stage_row, acc_val, acc_row, sems, carry,
+                   *, cap_pad: int, mxu: bool):
+    """Duplicate-anchor streaming: dsel integrates to a per-edge
+    MULTIPLICITY m(e) in [0, MDUP] (duplicated runs scatter +k/-k at their
+    shared boundaries), each edge occupies m(e) consecutive output rows
+    (edge-repeat order — bag semantics downstream), and instead of a
+    parent id the kernel emits a ROW POSITION rowpos = dupstart(run) +
+    copy_index; the XLA wrapper resolves parents with one sorted-rank
+    gather. drow integrates to dupstart(run) per edge (deltas at
+    first-occurrence run starts, like dpar).
+
+    SMEM carry: [0]=mult prefix, [1]=rowbase prefix, [2]=acc fill,
+    [3]=blocks emitted, [4+slot]=block per staging slot, [6+slot]=busy."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    T = TILE
+    R = T // 128
+    A = (MDUP + 1) * T  # accumulator rows: fill < T plus <= MDUP*T new
+    t = pl.program_id(0)
+    G = pl.num_programs(0)
+
+    @pl.when(t == 0)
+    def _init():
+        for k in range(8):
+            carry[k] = 0
+        acc_val[...] = jnp.zeros((A, 1), jnp.int32)
+        acc_row[...] = jnp.zeros((A, 1), jnp.int32)
+
+    es2 = edges_ref[...].reshape(R, 128)
+    dsel2 = dsel_ref[...].reshape(R, 128)
+    drow2 = drow_ref[...].reshape(R, 128)
+
+    mult = jnp.maximum(_psum_small(dsel2, incl=True) + carry[0], 0)
+    crow = _psum_i32(drow2, incl=True) + carry[1]
+    lrank = _psum_small(mult, incl=False)  # exclusive, < MDUP*T (fp32-exact)
+    count = jnp.sum(mult)
+    f = carry[2]
+
+    mult_r = mult.reshape(1, T)
+    lrank_r = lrank.reshape(1, T) + f
+    es_r = es2.reshape(1, T)
+    # rowpos(ii) = rowbase[j] + (ii - lrank[j]) for the edge j covering
+    # output row ii; q = rowbase - lrank (+offset so both halves stay
+    # non-negative: rowbase < C <= 2^25, lrank < (MDUP+1)*T)
+    q_r = crow.reshape(1, T) - lrank_r + jnp.int32(_ROW_OFF)
+    ii = jax.lax.broadcasted_iota(jnp.int32, (A, T), 0)
+    m2 = (ii >= lrank_r) & (ii < lrank_r + mult_r)
+    ii_col = jax.lax.broadcasted_iota(jnp.int32, (A, 1), 0)
+    if mxu:
+        mf = m2.astype(jnp.float32)  # (A, T)
+        halves = jnp.concatenate([
+            (es_r >> 16).reshape(T, 1), (es_r & 0xFFFF).reshape(T, 1),
+            (q_r >> 16).reshape(T, 1), (q_r & 0xFFFF).reshape(T, 1),
+            jnp.ones((T, 1), jnp.int32),
+        ], axis=1).astype(jnp.float32)  # (T, 5)
+        out5 = jnp.dot(mf, halves,
+                       preferred_element_type=jnp.float32).astype(jnp.int32)
+        cov = out5[:, 4:5]  # covered-row indicator (0/1)
+        acc_val[...] = acc_val[...] + (out5[:, 0:1] * jnp.int32(1 << 16)
+                                       + out5[:, 1:2])
+        acc_row[...] = acc_row[...] + (
+            out5[:, 2:3] * jnp.int32(1 << 16) + out5[:, 3:4]
+            + (ii_col - jnp.int32(_ROW_OFF)) * cov)
+    else:
+        cov = jnp.sum(m2.astype(jnp.int32), axis=1, keepdims=True)
+        acc_val[...] = acc_val[...] + jnp.sum(
+            jnp.where(m2, es_r, 0), axis=1, keepdims=True)
+        acc_row[...] = acc_row[...] + (
+            jnp.sum(jnp.where(m2, q_r, 0), axis=1, keepdims=True)
+            + (ii_col - jnp.int32(_ROW_OFF)) * cov)
+    fnew = f + count
+    _wait_slot, _start_block = _dma_ring(stage_val, stage_row, val_out,
+                                         row_out, sems, carry, cap_pad)
+
+    # flush every full block (up to MDUP+1 per tile), then slide the tail
+    # block down and clear the rest — rows at/after fnew are always zero,
+    # so the dynamic tail read only moves live data + zeros
+    nblk = fnew // T
+    for k in range(MDUP + 1):
+        @pl.when(k < nblk)
+        def _(k=k):
+            blk = carry[3] + k
+            slot = (carry[3] + k) % 2
+            _wait_slot(slot)
+            _start_block(blk, slot, acc_val[k * T:(k + 1) * T],
+                         acc_row[k * T:(k + 1) * T])
+
+    tail_val = acc_val[pl.ds(nblk * T, T)]
+    tail_row = acc_row[pl.ds(nblk * T, T)]
+    acc_val[...] = jnp.zeros((A, 1), jnp.int32)
+    acc_row[...] = jnp.zeros((A, 1), jnp.int32)
+    acc_val[0:T] = tail_val
+    acc_row[0:T] = tail_row
+    carry[3] = carry[3] + nblk
+    carry[2] = fnew - nblk * T
+    carry[0] = carry[0] + jnp.sum(dsel2)
+    carry[1] = carry[1] + jnp.sum(drow2)
+
+    @pl.when(t == G - 1)
+    def _fin():
+        blk = carry[3]
+        f_end = carry[2]
+        slot = blk % 2
+        _wait_slot(slot)
+        _start_block(blk, slot, acc_val[0:T], acc_row[0:T])
+        _wait_slot(slot)
+        _wait_slot(1 - slot)
+        total_out[0, 0] = blk * T + f_end
+
+
+def _stream_emit_m(edges2, dsel2, drow2, cap_out: int, interpret: bool = False,
+                   mxu: bool | None = None):
+    """pallas_call wrapper for the m-hot kernel: returns (val [cap_pad, 1],
+    rowpos [cap_pad, 1], emitted [1]); cap_pad = cap_out + (MDUP+1)*TILE so
+    every in-capacity flush block stays aligned and disjoint."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    G = edges2.shape[0]
+    T = TILE
+    A = (MDUP + 1) * T
+    cap_pad = cap_out + A
+    tile = pl.BlockSpec((1, T), lambda t: (t, 0), memory_space=pltpu.VMEM)
+    kern = partial(_emit_kernel_m, cap_pad=cap_pad,
+                   mxu=USE_MXU_COMPACT if mxu is None else mxu)
+    val, rowpos, total = pl.pallas_call(
+        kern,
+        grid=(G,),
+        in_specs=[tile, tile, tile],
+        out_shape=(jax.ShapeDtypeStruct((cap_pad, 1), jnp.int32),
+                   jax.ShapeDtypeStruct((cap_pad, 1), jnp.int32),
+                   jax.ShapeDtypeStruct((1, 1), jnp.int32)),
+        out_specs=(pl.BlockSpec(memory_space=pl.ANY),
+                   pl.BlockSpec(memory_space=pl.ANY),
+                   pl.BlockSpec(memory_space=pltpu.SMEM)),
+        scratch_shapes=[
+            pltpu.VMEM((2, T, 1), jnp.int32),  # stage_val
+            pltpu.VMEM((2, T, 1), jnp.int32),  # stage_row
+            pltpu.VMEM((A, 1), jnp.int32),     # acc_val
+            pltpu.VMEM((A, 1), jnp.int32),     # acc_row
+            pltpu.SemaphoreType.DMA((2, 2)),
+            pltpu.SMEM((8,), jnp.int32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+            has_side_effects=True,
+        ),
+        interpret=interpret,
+    )(edges2, dsel2, drow2)
+    return val, rowpos, total
+
+
+# ---------------------------------------------------------------------------
 # the drop-in expand (merge_expand contract)
 # ---------------------------------------------------------------------------
 
 
-@partial(jax.jit, static_argnames=("cap_out", "interpret", "mxu"))
+@partial(jax.jit, static_argnames=("cap_out", "interpret", "mxu", "mhot"))
 def stream_expand(skey, sstart, sdeg, edges, cur, n, live, cap_out: int,
-                  interpret: bool = False, mxu: bool | None = None):
-    """known_to_unknown expansion with the streaming emitter; identical
-    contract and output order to tpu_kernels.merge_expand (edge order =
-    key-sorted anchor order): (val [cap_out], parent [cap_out], out_n,
-    total). Falls back to the XLA emit via lax.cond when duplicate anchor
-    values are present (overlapping runs defeat delta integration)."""
+                  interpret: bool = False, mxu: bool | None = None,
+                  mhot: bool = True):
+    """known_to_unknown expansion with the streaming emitter: (val
+    [cap_out], parent [cap_out], out_n, total).
+
+    Distinct-anchor frontiers are bit-identical to
+    tpu_kernels.merge_expand (edge order = key-sorted anchor order).
+    Duplicate-anchor frontiers with per-key multiplicity <= MDUP stream
+    through the m-hot kernel (edge-repeat order — a permutation of the
+    same bag; downstream is order-insensitive); higher multiplicity falls
+    back to the XLA emit. `mhot=False` drops the middle arm entirely (for
+    backends where the m-hot kernel fails to lower)."""
     from wukong_tpu.engine import tpu_kernels as K
 
     C = cur.shape[0]
@@ -382,6 +599,57 @@ def stream_expand(skey, sstart, sdeg, edges, cur, n, live, cap_out: int,
         val, parent = K._emit_gather(ts, S, start, deg, st_ex, edges,
                                      total, cap_out)
         return val, parent
+
+    # per-row group bookkeeping in merged-sorted order (the segment row
+    # sorts first within each key, duplicates follow adjacently) — shared
+    # by the m-hot arm and its multiplicity gate
+    is_run = (~is_seg) & found & (deg > 0)
+    rank = jnp.cumsum(is_run.astype(jnp.int32)) - 1
+    SC = is_run.shape[0]
+    prev_run = jnp.concatenate([jnp.zeros(1, bool), is_run[:-1]])
+    # prev_ks[0] is arbitrary: prev_run[0] is False, so it never matters
+    prev_ks = jnp.concatenate([ks[:1], ks[:-1]])
+    first_occ = is_run & ~(prev_run & (prev_ks == ks))
+
+    def _mhot(_):
+        Et = max(E, T)
+        # dsel over ALL runs: duplicated boundaries accumulate multiplicity
+        tgt = jnp.where(is_run, rank, SC)
+        rstart = jnp.zeros(SC, jnp.int32).at[tgt].set(start, mode="drop")
+        rdeg = jnp.zeros(SC, jnp.int32).at[tgt].set(deg, mode="drop")
+        n_runs = jnp.sum(is_run.astype(jnp.int32))
+        valid_r = jnp.arange(SC, dtype=jnp.int32) < n_runs
+        s_idx = jnp.where(valid_r, rstart, Et)
+        e_idx = jnp.where(valid_r, rstart + rdeg, Et)
+        dsel = (jnp.zeros(Et + 1, jnp.int32)
+                .at[s_idx].add(1, mode="drop")
+                .at[e_idx].add(-1, mode="drop"))
+        # drow: dupstart deltas at FIRST-occurrence run starts only
+        rk1 = jnp.cumsum(first_occ.astype(jnp.int32)) - 1
+        tgt1 = jnp.where(first_occ, rk1, SC)
+        r1start = jnp.zeros(SC, jnp.int32).at[tgt1].set(start, mode="drop")
+        r1dst = jnp.zeros(SC, jnp.int32).at[tgt1].set(
+            jnp.where(first_occ, rank, 0), mode="drop")
+        n1 = jnp.sum(first_occ.astype(jnp.int32))
+        valid1 = jnp.arange(SC, dtype=jnp.int32) < n1
+        s1 = jnp.where(valid1, r1start, Et)
+        prev1 = jnp.concatenate([r1dst[:1] * 0, r1dst[:-1]])
+        d1 = jnp.where(valid1, r1dst - prev1, 0)
+        drow = jnp.zeros(Et + 1, jnp.int32).at[s1].add(d1, mode="drop")
+        # parents of found rows in sorted-rank order (the rowpos codomain)
+        parents_sorted = jnp.zeros(SC, jnp.int32).at[tgt].set(
+            ts - S, mode="drop")
+
+        ed = edges if E >= T else jnp.pad(edges, (0, T - E),
+                                          constant_values=INT32_MAX)
+        G = Et // T
+        v2, rp2, _tot = _stream_emit_m(ed.reshape(G, T),
+                                       dsel[:Et].reshape(G, T),
+                                       drow[:Et].reshape(G, T),
+                                       cap_out=cap_out, interpret=interpret,
+                                       mxu=mxu)
+        rowpos = jnp.clip(rp2[:cap_out, 0], 0, SC - 1)
+        return v2[:cap_out, 0], parents_sorted[rowpos]
 
     def _stream(_):
         # compact matched runs (disjoint, ascending starts in key order)
@@ -416,7 +684,17 @@ def stream_expand(skey, sstart, sdeg, edges, cur, n, live, cap_out: int,
                                     mxu=mxu)
         return v2[:cap_out, 0], p2[:cap_out, 0]
 
-    val, parent = jax.lax.cond(dup, _xla, _stream, None)
+    if mhot:
+        # per-key multiplicity bound decides the middle arm on device
+        dupstart_g = jax.lax.cummax(jnp.where(first_occ, rank, -1))
+        mmax = jnp.max(jnp.where(is_run, rank - dupstart_g + 1, 0))
+
+        def _dup_arm(_):
+            return jax.lax.cond(mmax <= MDUP, _mhot, _xla, None)
+
+        val, parent = jax.lax.cond(dup, _dup_arm, _stream, None)
+    else:
+        val, parent = jax.lax.cond(dup, _xla, _stream, None)
     j = jnp.arange(cap_out, dtype=jnp.int32)
     okj = j < total
     return (jnp.where(okj, val, 0), jnp.where(okj, parent, 0),
