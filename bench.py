@@ -164,7 +164,8 @@ PARTIAL_MAX_AGE_S = 24 * 3600
 def _toggles_key() -> str:
     return ",".join(f"{k}={os.environ.get(k, '1')}" for k in
                     ("WUKONG_ENABLE_MERGE", "WUKONG_ENABLE_PALLAS",
-                     "WUKONG_ENABLE_FP_PROBE", "WUKONG_ENABLE_STREAM"))
+                     "WUKONG_ENABLE_FP_PROBE", "WUKONG_ENABLE_STREAM",
+                     "WUKONG_ENABLE_STREAM_MHOT"))
 
 
 def _partial_key(scale: int, qn: str, backend: str) -> str:
@@ -220,7 +221,8 @@ def _partial_fresh(d: dict) -> bool:
 def _ab_partials(scale: int, qn: str, store: dict) -> dict:
     """On-chip measurements of the SAME query under non-default kernel
     toggles (the loop cycles WUKONG_ENABLE_MERGE=0 / WUKONG_ENABLE_STREAM=0
-    passes): {toggle-diff: us}. Surfaces the kernel A/B in the artifact.
+    / WUKONG_ENABLE_STREAM_MHOT=0 passes): {toggle-diff: us}. Surfaces the
+    kernel A/B in the artifact.
     Same freshness contract as _best_tpu_partial (stale entries measured
     older code and must not masquerade as the current A/B)."""
     from wukong_tpu.loader.lubm import DATASET_VERSION

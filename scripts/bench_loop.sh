@@ -19,15 +19,17 @@ import jax, jax.numpy as jnp, sys
 jax.device_get(jnp.arange(2) + 1)
 sys.exit(0 if jax.devices()[0].platform != 'cpu' else 1)" >/dev/null 2>&1; then
     # cycle kernel A/Bs so the partial store accumulates comparison points:
-    # default first (the headline), then merge-off, then stream-off
-    case $((PASS % 3)) in
+    # default first (the headline), then merge-off, stream-off, mhot-off
+    case $((PASS % 4)) in
       0) AB="" ;;
       1) AB="WUKONG_ENABLE_MERGE=0" ;;
       2) AB="WUKONG_ENABLE_STREAM=0" ;;
+      3) AB="WUKONG_ENABLE_STREAM_MHOT=0" ;;
     esac
     echo "[$(date +%F' '%T)] backend healthy -> bench @ LUBM-$WUKONG_BENCH_SCALE ${AB:-default}" >> "$LOG"
     env $AB timeout 10800 python bench.py >> "$LOG" 2>&1
-    echo "[$(date +%F' '%T)] bench pass done (rc=$?)" >> "$LOG"
+    rc=$?  # captured before $(date) in the echo resets $?
+    echo "[$(date +%F' '%T)] bench pass done (rc=$rc)" >> "$LOG"
     PASS=$((PASS + 1))
     sleep 60
   else
