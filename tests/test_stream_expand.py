@@ -298,3 +298,57 @@ def test_stream_fuzz_random_shapes(seed):
     assert int(a[3]) == int(b[3]) and int(a[2]) == int(b[2])
     assert np.array_equal(np.asarray(a[0]), np.asarray(b[0]))
     assert np.array_equal(np.asarray(a[1]), np.asarray(b[1]))
+
+
+@pytest.mark.parametrize("mdup", [2, 8])
+def test_stream_mdup_override(mdup):
+    """Non-default multiplicity caps: the mdup-scaled accumulator/flush
+    logic must stay bag-equal to the XLA emit for multiplicities within
+    the cap, and beyond-cap frontiers must still fall back bit-identical."""
+    rng = np.random.default_rng(21)
+    sk, ss, sd, e, keys, offs = _mk_segment(rng, nkeys=80, max_deg=6)
+    C = 512
+    picks = rng.choice(keys, size=40, replace=False)
+    anchors = np.repeat(picks, mdup)  # multiplicity exactly at the cap
+    n = len(anchors)
+    cur = np.full(C, INT32_MAX, np.int32)
+    cur[:n] = anchors
+    live = np.ones(C, bool)
+    a = merge_expand(jnp.asarray(sk), jnp.asarray(ss), jnp.asarray(sd),
+                     jnp.asarray(e), jnp.asarray(cur), jnp.int32(n),
+                     jnp.asarray(live), cap_out=1 << 13)
+    b = stream_expand(jnp.asarray(sk), jnp.asarray(ss), jnp.asarray(sd),
+                      jnp.asarray(e), jnp.asarray(cur), jnp.int32(n),
+                      jnp.asarray(live), cap_out=1 << 13, interpret=True,
+                      mdup=mdup)
+    av, ap, an, at = [np.asarray(x) for x in a]
+    bv, bp, bn, bt = [np.asarray(x) for x in b]
+    assert int(at) == int(bt) and int(an) == int(bn) and int(at) > 0
+    assert _multiset(av, ap, int(an)) == _multiset(bv, bp, int(bn))
+    # one past the cap: the XLA arm takes over, bit-identical
+    anchors2 = np.repeat(picks[:30], mdup + 1)
+    n2 = len(anchors2)
+    cur2 = np.full(C, INT32_MAX, np.int32)
+    cur2[:n2] = anchors2
+    a = merge_expand(jnp.asarray(sk), jnp.asarray(ss), jnp.asarray(sd),
+                     jnp.asarray(e), jnp.asarray(cur2), jnp.int32(n2),
+                     jnp.asarray(live), cap_out=1 << 13)
+    b = stream_expand(jnp.asarray(sk), jnp.asarray(ss), jnp.asarray(sd),
+                      jnp.asarray(e), jnp.asarray(cur2), jnp.int32(n2),
+                      jnp.asarray(live), cap_out=1 << 13, interpret=True,
+                      mdup=mdup)
+    for x, y in zip(a, b):
+        assert np.array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_stream_mdup_env(monkeypatch):
+    from wukong_tpu.engine.tpu_stream import MDUP, stream_mdup
+
+    monkeypatch.delenv("WUKONG_STREAM_MDUP", raising=False)
+    assert stream_mdup() == MDUP
+    monkeypatch.setenv("WUKONG_STREAM_MDUP", "8")
+    assert stream_mdup() == 8
+    monkeypatch.setenv("WUKONG_STREAM_MDUP", "bogus")
+    assert stream_mdup() == MDUP
+    monkeypatch.setenv("WUKONG_STREAM_MDUP", "99")
+    assert stream_mdup() == 16  # clamped

@@ -537,7 +537,8 @@ class MergeExecutor:
                     seg.skey, seg.sstart, seg.sdeg, seg.edges, cur, state.n,
                     state.live_mask(), cap_out=cap_out,
                     interpret=tpu_stream.FORCE_INTERPRET,
-                    mhot=tpu_stream.mhot_enabled())
+                    mhot=tpu_stream.mhot_enabled(),
+                    mdup=tpu_stream.stream_mdup())
             else:
                 vals, parent, n, total = K.merge_expand(
                     seg.skey, seg.sstart, seg.sdeg, seg.edges, cur, state.n,

@@ -97,7 +97,8 @@ def stream_available() -> bool:
             live = jnp.ones(8, bool)
             v, p, n, t = stream_expand(skey, sstart, sdeg, edges, cur,
                                        jnp.int32(6), live, cap_out=1024,
-                                       mxu=mxu, mhot=mhot)
+                                       mxu=mxu, mhot=mhot,
+                                       mdup=stream_mdup())
             if not (int(n) == 2 and int(v[0]) == big
                     and int(v[1]) == 65_537 and int(p[0]) == 5
                     and int(p[1]) == 5):
@@ -109,7 +110,8 @@ def stream_available() -> bool:
                 cur2 = cur.at[1].set(3)
                 v, p, n, t = stream_expand(skey, sstart, sdeg, edges, cur2,
                                            jnp.int32(6), live, cap_out=1024,
-                                           mxu=mxu, mhot=True)
+                                           mxu=mxu, mhot=True,
+                                           mdup=stream_mdup())
                 got = sorted((int(v[i]), int(p[i])) for i in range(int(n)))
                 want = sorted([(big, 1), (big, 5), (65_537, 1), (65_537, 5)])
                 return int(t) == 4 and got == want
@@ -141,6 +143,18 @@ def mhot_enabled() -> bool:
     if os.environ.get("WUKONG_ENABLE_STREAM_MHOT", "1") == "0":
         return False
     return _stream_state["mhot"]
+
+
+def stream_mdup() -> int:
+    """The active multiplicity cap: WUKONG_STREAM_MDUP env (hardware tuning
+    — e.g. 8 lets B=8 replicate heavy batches stream) or the MDUP default."""
+    import os
+
+    try:
+        v = int(os.environ.get("WUKONG_STREAM_MDUP", MDUP))
+    except ValueError:
+        return MDUP
+    return max(1, min(v, 16))
 
 
 def want_stream(est_out: float, num_edges: int, cap_out: int) -> bool:
@@ -396,7 +410,9 @@ def _stream_emit(edges2, dsel2, dpar2, cap_out: int, interpret: bool = False,
 # m-hot variant: duplicate-anchor frontiers with multiplicity <= MDUP
 # ---------------------------------------------------------------------------
 
-MDUP = 4  # static multiplicity cap for the m-hot arm (plane height scales)
+MDUP = 4  # default m-hot multiplicity cap (plane height scales with it;
+#           override per call via stream_expand(..., mdup=...) or the
+#           WUKONG_STREAM_MDUP env consulted by stream_mdup())
 
 _ROW_OFF = 1 << 18  # keeps the q payload non-negative for the halves trick
 
@@ -404,9 +420,9 @@ _ROW_OFF = 1 << 18  # keeps the q payload non-negative for the halves trick
 def _emit_kernel_m(edges_ref, dsel_ref, drow_ref,
                    val_out, row_out, total_out,
                    stage_val, stage_row, acc_val, acc_row, sems, carry,
-                   *, cap_pad: int, mxu: bool):
+                   *, cap_pad: int, mxu: bool, mdup: int):
     """Duplicate-anchor streaming: dsel integrates to a per-edge
-    MULTIPLICITY m(e) in [0, MDUP] (duplicated runs scatter +k/-k at their
+    MULTIPLICITY m(e) in [0, mdup] (duplicated runs scatter +k/-k at their
     shared boundaries), each edge occupies m(e) consecutive output rows
     (edge-repeat order — bag semantics downstream), and instead of a
     parent id the kernel emits a ROW POSITION rowpos = dupstart(run) +
@@ -421,7 +437,7 @@ def _emit_kernel_m(edges_ref, dsel_ref, drow_ref,
 
     T = TILE
     R = T // 128
-    A = (MDUP + 1) * T  # accumulator rows: fill < T plus <= MDUP*T new
+    A = (mdup + 1) * T  # accumulator rows: fill < T plus <= mdup*T new
     t = pl.program_id(0)
     G = pl.num_programs(0)
 
@@ -438,7 +454,7 @@ def _emit_kernel_m(edges_ref, dsel_ref, drow_ref,
 
     mult = jnp.maximum(_psum_small(dsel2, incl=True) + carry[0], 0)
     crow = _psum_i32(drow2, incl=True) + carry[1]
-    lrank = _psum_small(mult, incl=False)  # exclusive, < MDUP*T (fp32-exact)
+    lrank = _psum_small(mult, incl=False)  # exclusive, < mdup*T (fp32-exact)
     count = jnp.sum(mult)
     f = carry[2]
 
@@ -447,7 +463,7 @@ def _emit_kernel_m(edges_ref, dsel_ref, drow_ref,
     es_r = es2.reshape(1, T)
     # rowpos(ii) = rowbase[j] + (ii - lrank[j]) for the edge j covering
     # output row ii; q = rowbase - lrank (+offset so both halves stay
-    # non-negative: rowbase < C <= 2^25, lrank < (MDUP+1)*T)
+    # non-negative: rowbase < C <= 2^25, lrank < (mdup+1)*T <= 17*T < 2^18)
     q_r = crow.reshape(1, T) - lrank_r + jnp.int32(_ROW_OFF)
     ii = jax.lax.broadcasted_iota(jnp.int32, (A, T), 0)
     m2 = (ii >= lrank_r) & (ii < lrank_r + mult_r)
@@ -478,11 +494,11 @@ def _emit_kernel_m(edges_ref, dsel_ref, drow_ref,
     _wait_slot, _start_block = _dma_ring(stage_val, stage_row, val_out,
                                          row_out, sems, carry, cap_pad)
 
-    # flush every full block (up to MDUP+1 per tile), then slide the tail
+    # flush every full block (up to mdup+1 per tile), then slide the tail
     # block down and clear the rest — rows at/after fnew are always zero,
     # so the dynamic tail read only moves live data + zeros
     nblk = fnew // T
-    for k in range(MDUP + 1):
+    for k in range(mdup + 1):
         @pl.when(k < nblk)
         def _(k=k):
             blk = carry[3] + k
@@ -515,20 +531,20 @@ def _emit_kernel_m(edges_ref, dsel_ref, drow_ref,
 
 
 def _stream_emit_m(edges2, dsel2, drow2, cap_out: int, interpret: bool = False,
-                   mxu: bool | None = None):
+                   mxu: bool | None = None, mdup: int = MDUP):
     """pallas_call wrapper for the m-hot kernel: returns (val [cap_pad, 1],
-    rowpos [cap_pad, 1], emitted [1]); cap_pad = cap_out + (MDUP+1)*TILE so
+    rowpos [cap_pad, 1], emitted [1]); cap_pad = cap_out + (mdup+1)*TILE so
     every in-capacity flush block stays aligned and disjoint."""
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     G = edges2.shape[0]
     T = TILE
-    A = (MDUP + 1) * T
+    A = (mdup + 1) * T
     cap_pad = cap_out + A
     tile = pl.BlockSpec((1, T), lambda t: (t, 0), memory_space=pltpu.VMEM)
     kern = partial(_emit_kernel_m, cap_pad=cap_pad,
-                   mxu=USE_MXU_COMPACT if mxu is None else mxu)
+                   mxu=USE_MXU_COMPACT if mxu is None else mxu, mdup=mdup)
     val, rowpos, total = pl.pallas_call(
         kern,
         grid=(G,),
@@ -561,10 +577,11 @@ def _stream_emit_m(edges2, dsel2, drow2, cap_out: int, interpret: bool = False,
 # ---------------------------------------------------------------------------
 
 
-@partial(jax.jit, static_argnames=("cap_out", "interpret", "mxu", "mhot"))
+@partial(jax.jit, static_argnames=("cap_out", "interpret", "mxu", "mhot",
+                                   "mdup"))
 def stream_expand(skey, sstart, sdeg, edges, cur, n, live, cap_out: int,
                   interpret: bool = False, mxu: bool | None = None,
-                  mhot: bool = True):
+                  mhot: bool = True, mdup: int = MDUP):
     """known_to_unknown expansion with the streaming emitter: (val
     [cap_out], parent [cap_out], out_n, total).
 
@@ -647,7 +664,7 @@ def stream_expand(skey, sstart, sdeg, edges, cur, n, live, cap_out: int,
                                        dsel[:Et].reshape(G, T),
                                        drow[:Et].reshape(G, T),
                                        cap_out=cap_out, interpret=interpret,
-                                       mxu=mxu)
+                                       mxu=mxu, mdup=mdup)
         rowpos = jnp.clip(rp2[:cap_out, 0], 0, SC - 1)
         return v2[:cap_out, 0], parents_sorted[rowpos]
 
@@ -690,7 +707,7 @@ def stream_expand(skey, sstart, sdeg, edges, cur, n, live, cap_out: int,
         mmax = jnp.max(jnp.where(is_run, rank - dupstart_g + 1, 0))
 
         def _dup_arm(_):
-            return jax.lax.cond(mmax <= MDUP, _mhot, _xla, None)
+            return jax.lax.cond(mmax <= mdup, _mhot, _xla, None)
 
         val, parent = jax.lax.cond(dup, _dup_arm, _stream, None)
     else:
