@@ -313,3 +313,54 @@ def test_fuzz_union_optional_all_engines(world, seed, eight_cpu_devices):
         assert outs["dist"] == outs["cpu"], \
             ("dist", pats, unions, optionals,
              len(outs["dist"]), len(outs["cpu"]))
+
+
+def test_versatile_in_union_and_optional_children(world, eight_cpu_devices):
+    """VERSATILE patterns inside UNION branches and OPTIONAL groups: the
+    three engines route children through entirely different machinery
+    (host kernels / device expand2 / shard_map expand_versatile) and must
+    agree on the composed result."""
+    triples, meta, g, stats = world
+    cpu = CPUEngine(g, None)
+    tpu = TPUEngine(g, None, stats=stats)
+    dist = _fuzz_dist(triples)
+    norm = triples[triples[:, 1] != TYPE_ID]
+    row = norm[0]
+    c, p0 = int(row[0]), int(row[1])
+
+    def mk(unions, optional):
+        q = SPARQLQuery()
+        q.pattern_group.patterns = [Pattern(c, p0, OUT, -1)]
+        if unions:
+            for _ in range(2):
+                u = PatternGroup()
+                u.patterns = [Pattern(-1, -2, OUT, -3)]
+                q.pattern_group.unions.append(u)
+        if optional:
+            og = PatternGroup()
+            og.patterns = [Pattern(-1, -4 if unions else -2,
+                                   OUT, -5 if unions else -3)]
+            q.pattern_group.optional.append(og)
+        q.result.required_vars = sorted(
+            {v for pt in (q.pattern_group.patterns
+                          + [x for u in q.pattern_group.unions
+                             for x in u.patterns]
+                          + [x for o in q.pattern_group.optional
+                             for x in o.patterns])
+             for v in (pt.subject, pt.predicate, pt.object) if v < 0},
+            reverse=True)
+        q.result.nvars = len(q.result.required_vars)
+        return q
+
+    for unions, optional in ((True, False), (False, True), (True, True)):
+        outs = {}
+        for name, eng in (("cpu", cpu), ("tpu", tpu), ("dist", dist)):
+            q = mk(unions, optional)
+            eng.execute(q, from_proxy=False)
+            assert q.result.status_code == 0, (name, unions, optional)
+            cols = [q.result.var2col(v) for v in q.result.required_vars]
+            assert all(col != NO_RESULT for col in cols), (name, cols)
+            outs[name] = sorted(
+                map(tuple, np.asarray(q.result.table)[:, cols].tolist()))
+        assert outs["cpu"] == outs["tpu"] == outs["dist"], (unions, optional)
+        assert len(outs["cpu"]) > 0, (unions, optional)
