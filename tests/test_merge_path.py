@@ -235,3 +235,52 @@ def test_run_batch_index_many_matches_single(engines, world):
     assert len(many) == 3
     for counts in many:
         assert np.array_equal(np.asarray(counts), np.asarray(single))
+
+
+def test_bytes_model_roofline(engines, world):
+    """The host-side HBM-traffic model (bench roofline fields): after a run,
+    bytes_model reports the staged segment sizes actually in the device
+    cache plus a capacity-driven table-state term, and scales its table term
+    with B (capacity classes are per-batch)."""
+    _, tpu = engines
+    _, ss = world
+    q = _parse(ss, f"{BASIC}/lubm_q7")
+    tpu.execute_batch_index(q, 2)
+    bm = tpu.merge.bytes_model(q, 2, "rep")
+    assert bm is not None
+    assert bm["total_bytes"] == bm["segment_bytes"] + bm["table_bytes"]
+    assert bm["segment_bytes"] > 0 and bm["table_bytes"] > 0
+    # segment term counts what the kernels READ (expand skips ekey, k2k
+    # skips the key arrays), so it is bounded above by the staged bytes of
+    # the chain's pinned segments — all still cache-resident after the run
+    folds = tpu.merge._plan_folds(q.pattern_group.patterns, index_mode=True)
+    staged = 0
+    for key in tpu.merge._chain_pins(q.pattern_group.patterns, folds,
+                                     index_mode=True):
+        seg = tpu.dstore._cache.get(key)
+        if seg is not None:
+            staged += seg.nbytes
+        ent = tpu.dstore._index_cache.get(key)
+        if ent is not None:
+            staged += int(ent[0].size) * 4
+    # + the init index list (idx key, not a chain pin)
+    p0 = q.pattern_group.patterns[0]
+    ent = tpu.dstore._index_cache.get(
+        ("idx", int(p0.subject), int(p0.direction)))
+    if ent is not None:
+        staged += int(ent[0].size) * 4
+    assert 0 < bm["segment_bytes"] <= staged
+    # B-scaling: the table term grows with the batch, segments do not
+    q2 = _parse(ss, f"{BASIC}/lubm_q7")
+    tpu.execute_batch_index(q2, 4)
+    bm4 = tpu.merge.bytes_model(q2, 4, "rep")
+    assert bm4["table_bytes"] > bm["table_bytes"]
+    assert bm4["segment_bytes"] == bm["segment_bytes"]
+    # out-of-scope chains (versatile predicates) return None
+    from wukong_tpu.sparql.ir import Pattern, SPARQLQuery
+    from wukong_tpu.types import OUT
+
+    qv = SPARQLQuery()
+    qv.pattern_group.patterns = [Pattern(17, -3, OUT, -1)]
+    qv.result.nvars = 1
+    assert tpu.merge.bytes_model(qv, 2, "rep") is None

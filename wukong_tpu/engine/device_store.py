@@ -96,6 +96,14 @@ def fp_words(bkey_2d: np.ndarray):
     return w0.view(np.int32), w1.view(np.int32), dup
 
 
+def fold_key(filters) -> tuple:
+    """Canonical cache-key form of a fold's (pid, dir, const) filter list.
+    THE single definition — filtered_merge_segment's cache key, the chain
+    pins, and the bench roofline model all look segments up by it; a second
+    hand-written copy that drifted would silently miss the cache."""
+    return tuple(sorted((int(p), int(dd), int(c)) for (p, dd, c) in filters))
+
+
 def combined_adjacency(g, d: int):
     """(keys, offsets, vals, pids) of one partition's COMBINED adjacency in
     direction d: every (predicate, neighbor) edge keyed by vid, predicate-
@@ -372,8 +380,7 @@ class DeviceStore:
         expand over the pre-intersected segment. Host build is O(E + M)
         numpy (searchsorted membership), cached per (pid, d, filters)."""
         self._check_version()
-        fkey = tuple(sorted((int(p), int(dd), int(c)) for (p, dd, c)
-                            in filters))
+        fkey = fold_key(filters)
         key = ("mrgf", int(pid), int(d), fkey)
         if key in self._cache:
             self._touch(key)

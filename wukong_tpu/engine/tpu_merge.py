@@ -416,9 +416,9 @@ class MergeExecutor:
             pid, d, end = int(pat.predicate), int(pat.direction), pat.object
             if kind == "expand":
                 if fold is not None:
-                    fkey = tuple(sorted((int(p), int(dd), int(c))
-                                        for (p, dd, c) in fold[0]))
-                    add(("mrgf", pid, d, fkey))
+                    from wukong_tpu.engine.device_store import fold_key
+
+                    add(("mrgf", pid, d, fold_key(fold[0])))
                 else:
                     add(("mrg", pid, d))
             elif kind == "k2k":
@@ -479,6 +479,42 @@ class MergeExecutor:
         return folds
 
     # ------------------------------------------------------------------
+    # THE single capacity-transition policy: _dispatch (what the executor
+    # allocates) and bytes_model (what the bench artifact reports) both
+    # consume these three helpers — a second hand-maintained copy of the
+    # memo-or-estimate rule would silently desynchronize the published
+    # roofline bytes from the real allocation (the classify() lesson).
+    def _expand_est(self, pat, step: int, fold, step_est: dict,
+                    est_rows: float) -> float:
+        """Live-row estimate for an expand step: the planner's (post-fold)
+        step estimate when present, else fanout-propagated."""
+        est = step_est.get(fold[1] if fold is not None else step)
+        if est is None:
+            est = est_rows * self.eng._fanout(pat)
+        return est
+
+    def _expand_cap(self, step: int, est: float, cap_override: dict) -> int:
+        """Output capacity class of an expand: learned/memoized first, else
+        safety-margined estimate."""
+        eng = self.eng
+        return cap_override.get(step) or K.next_capacity(
+            max(int(min(est * eng.EST_SAFETY, eng.cap_max)), eng.cap_min),
+            eng.cap_min, eng.cap_max)
+
+    def _member_cap(self, step: int, step_est: dict,
+                    cap_override: dict) -> int | None:
+        """Post-membership compaction capacity (None = defer the filter)."""
+        eng = self.eng
+        cap_new = cap_override.get(step)
+        if cap_new is None:
+            se = step_est.get(step)
+            if se is not None:
+                cap_new = K.next_capacity(
+                    max(int(se * eng.EST_SAFETY), eng.cap_min),
+                    eng.cap_min, eng.cap_max)
+        return cap_new
+
+    # ------------------------------------------------------------------
     def _dispatch(self, q, pat, step: int, state: _MergeState,
                   cap_override: dict, step_est: dict,
                   fold_filters: list | None = None) -> None:
@@ -500,10 +536,9 @@ class MergeExecutor:
         e_known = end < 0 and end in state.var_level
         if end < 0 and not e_known:  # expand
             if fold_filters is not None:
-                filters, last_step = fold_filters
-                seg = eng.dstore.filtered_merge_segment(pid, d, filters)
+                seg = eng.dstore.filtered_merge_segment(pid, d,
+                                                        fold_filters[0])
             else:
-                filters, last_step = None, step
                 seg = eng.dstore.merge_segment(pid, d)
             if seg is None or seg.num_edges == 0:
                 state.levels.append(_Level(
@@ -514,16 +549,12 @@ class MergeExecutor:
                 state.live = None
                 return
             # folded filters make the POST-filter estimate (the last folded
-            # step's) the right capacity driver
-            est = step_est.get(last_step)
-            if est is None:
-                # live-row estimate, never capacity (capacity compounds
-                # geometrically and would inflate every later sort)
-                est = state.est_rows * eng._fanout(pat)
-            cap_out = cap_override.get(step) or K.next_capacity(
-                max(int(min(est * eng.EST_SAFETY, eng.cap_max)),
-                    eng.cap_min),
-                eng.cap_min, eng.cap_max)
+            # step's) the right capacity driver; live-row estimate, never
+            # capacity (capacity compounds geometrically and would inflate
+            # every later sort)
+            est = self._expand_est(pat, step, fold_filters, step_est,
+                                   state.est_rows)
+            cap_out = self._expand_cap(step, est, cap_override)
             state.est_rows = max(min(est, cap_out), 1.0)
             from wukong_tpu.engine import tpu_stream
 
@@ -564,12 +595,7 @@ class MergeExecutor:
             rev, real = eng.dstore.const_list(pid, d, end)
             keep = K.merge_member_list(rev, jnp.int32(real), cur,
                                        state.n, state.live_mask())
-        se = step_est.get(step)
-        cap_new = cap_override.get(step)
-        if cap_new is None and se is not None:
-            cap_new = K.next_capacity(
-                max(int(se * eng.EST_SAFETY), eng.cap_min),
-                eng.cap_min, eng.cap_max)
+        cap_new = self._member_cap(step, step_est, cap_override)
         if cap_new is not None and cap_new < state.cap:
             top = state.levels[-1]
             vals, parent, n, total = K.merge_compact(
@@ -583,3 +609,112 @@ class MergeExecutor:
             state.est_rows = max(min(state.est_rows, cap_new), 1.0)
         else:
             state.live = keep  # defer: fold into the next expand's degrees
+
+    # ------------------------------------------------------------------
+    def bytes_model(self, q, B: int, mode: str) -> dict | None:
+        """Host-side HBM-traffic model of the planned batch chain — the
+        roofline half of the bench artifact. Walks `classify` exactly as the
+        executors do and sums, per step, the segment arrays streamed plus
+        the binding-table state read/written, at the LEARNED capacity
+        classes (the memo written by the preceding run; estimate-driven
+        classes where no memo exists — same rule as `_dispatch`). Staged
+        device segments are sized from the DeviceStore cache when present
+        (what the chain actually streamed, filtered folds included);
+        evicted entries fall back to host CSR sizes. Each array is counted
+        ONCE per step — no sort-pass or materialize-walk multipliers — so
+        achieved-GB/s derived from this model is a LOWER bound on real
+        traffic. The reference reports raw latencies with no such model
+        (docs/performance/*.md); the 8x target needs the "is this near HBM
+        peak?" judgment, hence this accounting.
+
+        Returns {"segment_bytes", "table_bytes", "total_bytes"} or None for
+        chains the merge path does not own.
+        """
+        eng = self.eng
+        pats = q.pattern_group.patterns
+        if not pats or not self.supports(q):
+            return None
+        index_mode = mode != "const"
+        memo = self._cap_memo.get(self._key(pats, B, mode), {})
+        folds = self._plan_folds(pats, index_mode=index_mode)
+        step_est = {k: e * (1.0 if mode == "slice" else float(B))
+                    for k, e in eng._chain_estimates(pats).items()}
+        W = 4  # every staged array is int32
+
+        def seg_arrays(key, pid, d):
+            """(num_keys_padded, num_edges_padded) of a merge segment —
+            staged sizes when cached, host CSR lengths as fallback. An
+            EVICTED filtered-fold segment sizes as (0, 0): the unfiltered
+            CSR would overstate what the run streamed and break the
+            model's lower-bound guarantee."""
+            seg = eng.dstore._cache.get(key)
+            if seg is not None:
+                return int(seg.skey.size), int(seg.edges.size)
+            if key[0] == "mrgf":
+                return 0, 0
+            csr = eng.dstore._host_csr(pid, d)
+            if csr is None:
+                return 0, 0
+            keys, _offs, edges = csr
+            return len(keys), len(edges)
+
+        def list_bytes(key, host_len_fn):
+            ent = eng.dstore._index_cache.get(key)
+            if ent is not None:
+                return int(ent[0].size) * W
+            return host_len_fn() * W
+
+        seg_b = 0
+        tab_b = 0
+        if index_mode:
+            p0 = pats[0]
+            real = len(eng.g.get_index(p0.subject, p0.direction))
+            total0 = real if mode == "slice" else real * B
+            cap = K.next_capacity(max(total0, 1), eng.cap_min, eng.cap_max)
+            seg_b += list_bytes(("idx", int(p0.subject), int(p0.direction)),
+                                lambda: real)
+            tab_b += W * cap  # init writes the root level
+            est_rows = float(max(total0, 1))
+        else:
+            cap = K.next_capacity(B, eng.cap_min)
+            tab_b += W * cap
+            est_rows = float(B)
+        for k, pat, kind, fold in self.classify(pats, folds, index_mode):
+            pid, d, end = int(pat.predicate), int(pat.direction), pat.object
+            if kind == "expand":
+                # merge_expand / stream_expand read skey+sstart+sdeg+edges
+                # (ekey stays untouched on the expand path)
+                if fold is not None:
+                    from wukong_tpu.engine.device_store import fold_key
+
+                    nk, ne = seg_arrays(("mrgf", pid, d, fold_key(fold[0])),
+                                        pid, d)
+                else:
+                    nk, ne = seg_arrays(("mrg", pid, d), pid, d)
+                seg_b += W * (3 * nk + ne)
+                est = self._expand_est(pat, k, fold, step_est, est_rows)
+                cap_out = self._expand_cap(k, est, memo)
+                est_rows = max(min(est, cap_out), 1.0)
+                # read the anchor column, write (vals, parent)
+                tab_b += W * (cap + 2 * cap_out)
+                cap = cap_out
+                continue
+            if kind == "k2k":
+                # merge_member_pairs reads only the (ekey, edges) pair
+                # arrays, plus the two bound columns
+                _nk, ne = seg_arrays(("mrg", pid, d), pid, d)
+                seg_b += W * 2 * ne
+                tab_b += W * 2 * cap + cap  # two columns read + bool mask
+            else:  # k2c: merge_member_list reads the list + one column
+                seg_b += list_bytes(
+                    ("rev", pid, d, int(end)),
+                    lambda pid=pid, d=d, end=end: len(
+                        eng.dstore._const_members(pid, d, end)))
+                tab_b += W * cap + cap  # one column read + bool mask
+            cap_new = self._member_cap(k, step_est, memo)
+            if cap_new is not None and cap_new < cap:
+                tab_b += W * 2 * cap_new  # compact writes (vals, parent)
+                cap = cap_new
+                est_rows = max(min(est_rows, cap_new), 1.0)
+        return {"segment_bytes": int(seg_b), "table_bytes": int(tab_b),
+                "total_bytes": int(seg_b + tab_b)}
