@@ -109,6 +109,8 @@ class MergeExecutor:
     def __init__(self, engine):
         self.eng = engine  # TPUEngine: dstore, g, stats, cap bounds
         self._cap_memo: dict = {}  # (patterns key, B, mode) -> {step: cap}
+        self.total_retries = 0  # cumulative overflow-retry chains this
+        # process — the at-scale artifact's capacity-behavior evidence
 
     # ------------------------------------------------------------------
     def load_cap_memo(self, path: str) -> None:
@@ -360,6 +362,7 @@ class MergeExecutor:
                         self._cap_memo.clear()  # never wipe the fresh entry
                     self._cap_memo[memo_key] = dict(cap_override)
                     return np.asarray(host_counts)
+                self.total_retries += 1  # one re-run of the whole chain
             raise WukongError(ErrorCode.UNKNOWN_PATTERN,
                               "batch capacity retry limit exceeded")
         finally:
