@@ -75,6 +75,11 @@ class DistEngine:
         self.cap_min = Global.table_capacity_min
         self.cap_max = Global.table_capacity_max
         self._fn_cache: dict = {}
+        # per-chain observability (bench --dist artifact detail): set by the
+        # last successful _run_device_bgp — per-step row/exchange loads vs
+        # their capacity classes, and how many whole-chain retries were paid
+        self.last_chain_stats: dict | None = None
+        self._last_plan: _Plan | None = None
 
     # ------------------------------------------------------------------
     def execute(self, q: SPARQLQuery, from_proxy: bool = True) -> SPARQLQuery:
@@ -286,6 +291,26 @@ class DistEngine:
             raise WukongError(ErrorCode.UNKNOWN_PATTERN,
                               "distributed capacity retry limit exceeded")
 
+        # chain observability for the bench artifact (round-4 verdict #3:
+        # the 42x cpu-mesh number needs per-step evidence, not a single
+        # end-to-end time): per step, the peak per-shard row load and peak
+        # per-destination exchange load against their capacity classes
+        S = len(plan.steps)
+        step_stats = []
+        for i, s in enumerate(plan.steps):
+            st = {"kind": s.kind, "cap": s.cap,
+                  "rows_peak_shard": int(totals[:, i].max()),
+                  "rows_all_shards": int(totals[:, i].sum())}
+            if s.exch_cap:
+                st["exch_cap"] = s.exch_cap
+                st["exch_peak_dest"] = int(totals[:, S + i].max())
+            step_stats.append(st)
+        self.last_chain_stats = {"retries": int(_attempt),
+                                 "exchanges": sum(1 for s in plan.steps
+                                                  if s.exch_cap),
+                                 "steps": step_stats}
+        self._last_plan = plan
+
         res = q.result
         res.v2c_map = dict(plan.v2c)
         res.col_num = plan.width
@@ -303,6 +328,67 @@ class DistEngine:
             res.set_table(tab.astype(np.int64) & 0xFFFFFFFF
                           if tab.dtype == np.int32 else tab.astype(np.int64))
         q.pattern_step += n_steps
+
+    # ------------------------------------------------------------------
+    def bytes_model(self) -> dict | None:
+        """Host-side traffic model of the LAST executed chain (the dist
+        bench's roofline fields, round-4 verdict #4): staged segment arrays
+        read, sharded table state written at the capacity classes, and —
+        the number the 42x diagnosis needs — the capacity-PADDED collective
+        traffic (all_to_all ships [D, W, exch_cap] per shard regardless of
+        real row counts; expand_type_all allgathers the whole table). Each
+        array counted once; a lower bound on real traffic per executed
+        chain."""
+        plan = self._last_plan
+        if plan is None:
+            return None
+        W = 4  # int32 device arrays
+        D = self.D
+        seg_b = tab_b = exch_b = 0
+        width = 0
+        cap_prev = 0
+        for s in plan.steps:
+            w_in = width
+            if s.kind == "init_rows":
+                width = s.width
+                cap_prev = s.cap
+                tab_b += W * D * width * s.cap
+                continue
+            if s.kind == "init_index":
+                idx = self.sstore.index_list(s.pid, s.dir)
+                seg_b += int(idx.edges.size) * W
+                width = 1
+                cap_prev = s.cap
+                tab_b += W * D * s.cap
+                continue
+            if s.kind == "init_const":
+                seg = self.sstore.segment(s.pid, s.dir)
+                seg_b += int(seg.nbytes) if seg is not None else 0
+                width = 1
+                cap_prev = s.cap
+                tab_b += W * D * s.cap
+                continue
+            if s.exch_cap:
+                exch_b += W * D * D * w_in * s.exch_cap
+            if s.kind == "expand_type_all":
+                # allgather replication of the whole table to every shard
+                exch_b += W * D * D * w_in * cap_prev
+            if s.kind == "member_index":
+                idx = self.sstore.index_list(s.pid, s.dir)
+                seg_b += int(idx.edges.size) * W
+            elif s.kind in ("expand_versatile", "expand_versatile_const"):
+                vseg = self.sstore.versatile_segment(s.dir)
+                seg_b += int(vseg.nbytes) if vseg is not None else 0
+            else:
+                seg = self.sstore.segment(s.pid, s.dir)
+                seg_b += int(seg.nbytes) if seg is not None else 0
+            if s.new_col:
+                width += 2 if s.kind == "expand_versatile" else 1
+            tab_b += W * D * (w_in * cap_prev + width * s.cap)
+            cap_prev = s.cap
+        return {"segment_bytes": int(seg_b), "table_bytes": int(tab_b),
+                "exchange_bytes": int(exch_b),
+                "total_bytes": int(seg_b + tab_b + exch_b)}
 
     # ------------------------------------------------------------------
     # plan building (host): pattern list -> step descriptors with capacities
