@@ -105,11 +105,16 @@ def test_planned_chains_fit_hbm(store_meta):
         pin_bytes = 0
         for key in pins:
             if key[0] in ("mrg", "mrgf"):
+                # expands pin both merge and bucket forms but stage only
+                # ONE at runtime; the merge form bounds both (bucket form
+                # is 3 flat bucket arrays + edges, same magnitude), so
+                # count each expand once here and skip its bucket twin
                 nk, ne = store_meta.get((key[1], key[2]), (0, 0))
                 pin_bytes += _staged_bytes(nk, ne)  # mrgf <= unfiltered
-            else:  # rev list: bounded by the segment's key count
+            elif key[0] == "rev":  # rev list: bounded by the key count
                 nk, _ = store_meta.get((key[1], key[2]), (0, 0))
                 pin_bytes += 4 * _pow2(nk)
+            # bare (pid, d) / ("segf", ...) bucket twins: counted above
         expands = sum(1 for (_s, _p, kind, _f) in MergeExecutor.classify(
             pats, folds, index_mode) if kind == "expand")
         state_bytes = (expands + 1) * level_bytes

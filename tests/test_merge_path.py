@@ -284,3 +284,51 @@ def test_bytes_model_roofline(engines, world):
     qv.pattern_group.patterns = [Pattern(17, -3, OUT, -1)]
     qv.result.nvars = 1
     assert tpu.merge.bytes_model(qv, 2, "rep") is None
+
+
+@pytest.mark.parametrize("qfile", QUERIES,
+                         ids=[os.path.basename(f) for f in QUERIES])
+def test_probe_lookup_path_matches(world, qfile, monkeypatch):
+    """Force the probe-lookup arm for EVERY expand (factor 0: any segment
+    'wins') and pin count equality with the CPU oracle — the sort-vs-probe
+    dispatch must be invisible to results. A fresh engine avoids cap-memo
+    crosstalk with the suite's shared engine; pins are checked to stage the
+    BUCKET forms the probe path actually reads."""
+    from wukong_tpu.engine.tpu_merge import MergeExecutor
+
+    g, ss = world
+    cpu = CPUEngine(g, ss)
+    tpu = TPUEngine(g, ss)
+    monkeypatch.setattr(MergeExecutor, "PROBE_LOOKUP_FACTOR", 0)
+
+    oracle = _parse(ss, qfile)
+    oracle.result.blind = False
+    cpu.execute(oracle)
+    want = oracle.result.nrows
+
+    q = _parse(ss, qfile)
+    B = 3
+    if q.start_from_index():
+        counts = tpu.execute_batch_index(q, B)
+        mode = "rep"
+    else:
+        counts = tpu.execute_batch(
+            q, np.full(B, q.pattern_group.patterns[0].subject,
+                       dtype=np.int64))
+        mode = "const"
+    assert counts.tolist() == [want] * B
+    # pins include the bucket forms ((pid, d) / ("segf", ...)) for every
+    # expand; with probing forced, exactly those are what the run staged
+    pats = q.pattern_group.patterns
+    index_mode = mode == "rep"
+    folds = tpu.merge._plan_folds(pats, index_mode=index_mode)
+    pins = tpu.merge._chain_pins(pats, folds, index_mode=index_mode)
+    expand_pins = [k for k in pins
+                   if not (isinstance(k[0], str)
+                           and k[0] in ("mrg", "mrgf", "rev"))]
+    assert expand_pins, "no bucket-form pins for a chain with expands"
+    for k in expand_pins:
+        assert k in tpu.dstore._cache, f"pin {k} not staged by the run"
+    # and the traffic model prices the probe path (no full-segment stream)
+    bm = tpu.merge.bytes_model(q, B, mode)
+    assert bm is not None and bm["total_bytes"] > 0

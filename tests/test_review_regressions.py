@@ -382,12 +382,18 @@ def test_merge_chain_pins_match_staged_keys():
             Pattern(-1, 140003, OUT, 200123)]      # root k2c -> "rev" list
     folds = MergeExecutor._plan_folds(pats, index_mode=True)
     pins = MergeExecutor._chain_pins(pats, folds, index_mode=True)
+    # expands pin BOTH the merge and the bucket ("segf") forms: the live
+    # sort-vs-probe decision may stage either, and unstaged pins are free
     assert ("mrgf", 140000, int(OUT), ((int(TYPE_ID), int(OUT), 300001),)) \
         in pins
+    assert ("segf", 140000, int(OUT), ((int(TYPE_ID), int(OUT), 300001),)) \
+        in pins
     assert ("mrgf", 140001, int(OUT), ((int(TYPE_ID), int(OUT), 300002),)) \
+        in pins
+    assert ("segf", 140001, int(OUT), ((int(TYPE_ID), int(OUT), 300002),)) \
         in pins
     assert ("mrg", 140002, int(OUT)) in pins
     assert ("rev", 140003, int(OUT), 200123) in pins
     # folded steps must NOT appear as separate pins
     assert not any(k[0] == "rev" and k[-1] in (300001, 300002) for k in pins)
-    assert len(pins) == 4
+    assert len(pins) == 6

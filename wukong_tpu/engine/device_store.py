@@ -371,20 +371,21 @@ class DeviceStore:
                             edges=dev(e), ekey=dev(ek),
                             num_keys=K, num_edges=E)
 
-    def filtered_merge_segment(self, pid: int, d: int,
-                               filters: list) -> MergeSegment | None:
-        """Merge segment of (pid, d) with edges restricted to targets that
-        satisfy every (fpid, fd, fconst) k2c filter — the device analogue of
-        the reference planner's type-centric pruning (planner.hpp type
-        tables): an expand followed by `?v type T` membership becomes ONE
-        expand over the pre-intersected segment. Host build is O(E + M)
-        numpy (searchsorted membership), cached per (pid, d, filters)."""
+    def host_num_keys(self, pid: int, d: int) -> int:
+        """Key count of a (pid, dir) segment from HOST metadata only — the
+        merge chain's sort-vs-probe lookup dispatch reads just this scalar,
+        so the decision never stages anything. TYPE_ID IN resolves to the
+        type-index CSR, whose key set is exactly the partition's type ids."""
         self._check_version()
-        fkey = fold_key(filters)
-        key = ("mrgf", int(pid), int(d), fkey)
-        if key in self._cache:
-            self._touch(key)
-            return self._cache[key]
+        if int(pid) == TYPE_ID and int(d) == IN:
+            return len(self.g.type_ids)
+        host = self.g.segments.get((int(pid), int(d)))
+        return host.num_keys if host is not None else 0
+
+    def _filtered_host_csr(self, pid: int, d: int, fkey: tuple):
+        """Host CSR of (pid, d) with edges restricted to targets satisfying
+        every (fpid, fd, fconst) k2c filter — shared by the merge-form and
+        bucket-form filtered stagings. O(E log M) searchsorted membership."""
         csr = self._host_csr(pid, d)
         if csr is None:
             return None
@@ -409,8 +410,46 @@ class DeviceStore:
         foffs = np.zeros(len(fkeys) + 1, dtype=np.int64)
         np.cumsum(fdeg, out=foffs[1:])
         fedges = np.asarray(edges)[mask]
-        seg = self._stage_merge(fkeys, foffs, fedges)
+        return fkeys, foffs, fedges
+
+    def filtered_merge_segment(self, pid: int, d: int,
+                               filters: list) -> MergeSegment | None:
+        """Merge segment of (pid, d) with edges restricted to targets that
+        satisfy every (fpid, fd, fconst) k2c filter — the device analogue of
+        the reference planner's type-centric pruning (planner.hpp type
+        tables): an expand followed by `?v type T` membership becomes ONE
+        expand over the pre-intersected segment. Cached per (pid, d,
+        filters)."""
+        self._check_version()
+        fkey = fold_key(filters)
+        key = ("mrgf", int(pid), int(d), fkey)
+        if key in self._cache:
+            self._touch(key)
+            return self._cache[key]
+        csr = self._filtered_host_csr(pid, d, fkey)
+        if csr is None:
+            return None
+        seg = self._stage_merge(*csr)
         self._insert(key, seg)
+        return seg
+
+    def filtered_segment(self, pid: int, d: int,
+                         filters: list) -> DeviceSegment | None:
+        """Bucket-form twin of filtered_merge_segment, for the probe-lookup
+        expand path (small frontier over a filtered fold). Cached per
+        (pid, d, filters) under a distinct key."""
+        self._check_version()
+        fkey = fold_key(filters)
+        key = ("segf", int(pid), int(d), fkey)
+        if key in self._cache:
+            self._touch(key)
+            return self._cache[key]
+        csr = self._filtered_host_csr(pid, d, fkey)
+        if csr is None:
+            return None
+        seg = self._stage(*csr)
+        if seg is not None:
+            self._insert(key, seg)
         return seg
 
     def _const_members(self, pid: int, d: int, const: int) -> np.ndarray:

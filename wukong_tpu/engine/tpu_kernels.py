@@ -557,6 +557,41 @@ def _emit_gather(ts, S, start, deg, st_ex, edges, total, cap_out):
     return jnp.where(out_ok, val, 0), jnp.where(out_ok, parent, 0)
 
 
+@partial(jax.jit, static_argnames=("cap_out", "max_probe", "use_pallas",
+                                   "fp_dup"))
+def probe_expand(bkey, bstart, bdeg, edges, cur, n, live, cap_out,
+                 max_probe, use_pallas=False, fpw0=None, fpw1=None,
+                 fp_dup=0):
+    """known_to_unknown for the merge chain when the frontier is far
+    smaller than the segment: O(C) hash-probe run lookup against the v1
+    bucket table + the shared scatter-emit, instead of _merge_lookup's
+    O((S + C) log) variadic sort. At LUBM-2560 a light query's 1024-row
+    frontier joined against a 2^26-key segment pays ~150 ms/step in the
+    sort (the whole segment is re-sorted per call); the probe pays
+    ~max_probe row-contiguous gathers over the frontier only.
+
+    Same contract as merge_expand — (val [cap_out], parent [cap_out],
+    out_n, total), parents are input row ids — except output rows are in
+    INPUT row order rather than key-sorted anchor order (downstream is
+    order-insensitive: nothing assumes emission order).
+    """
+    C = cur.shape[0]
+    rows = jnp.arange(C, dtype=jnp.int32)
+    ok_row = (rows < n) & live
+    # bucket pads are -1, so INT32_MAX-masked rows can never match one
+    curm = jnp.where(ok_row, cur, INT32_MAX)
+    found, start, deg = _probe(bkey, bstart, bdeg, curm, n, max_probe,
+                               use_pallas, fpw0, fpw1, fp_dup)
+    deg = jnp.where(ok_row & found, deg, 0)
+    cum = jnp.cumsum(deg)
+    total = _saturate_total(cum)
+    st_ex = cum - deg
+    val, parent = _emit_gather(rows, 0, start, deg, st_ex, edges, total,
+                               cap_out)
+    return (val, parent,
+            jnp.minimum(total, cap_out).astype(jnp.int32), total)
+
+
 @partial(jax.jit, static_argnames=("cap_out",))
 def merge_expand(skey, sstart, sdeg, edges, cur, n, live, cap_out):
     """known_to_unknown without probes: returns (val [cap_out],

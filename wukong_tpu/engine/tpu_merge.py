@@ -400,13 +400,71 @@ class MergeExecutor:
             else:
                 yield k, pat, "k2c", None
 
+    # frontier-vs-segment lookup dispatch: merge_lookup re-sorts the WHOLE
+    # key array per call (O((S+C) log), ~150 ms/step for a 1024-row light
+    # frontier against a 2^26-key LUBM-2560 segment), the bucket probe pays
+    # ~max_probe row-contiguous gathers over the frontier only. Probe wins
+    # when the frontier is far smaller than the key set; 16x keeps the
+    # decision on the sort side near the crossover (on-chip constants:
+    # sort 2.2-3.1 ns/elem, gather ~9.5 ns/elem — ROADMAP.md table).
+    PROBE_LOOKUP_FACTOR = 16
+
+    def _probe_lookup_wins(self, cap_in: int, pid: int, d: int) -> bool:
+        """STATIC per capacity class (host metadata only — deciding must
+        never stage a segment). Consumed by _dispatch (live capacity) and
+        bytes_model (walked capacity); pins cover both outcomes, so a
+        learning-phase flip can't leave the staged form unprotected."""
+        return (self.eng.dstore.host_num_keys(pid, d)
+                >= cap_in * self.PROBE_LOOKUP_FACTOR)
+
+    def _walk_caps(self, pats, folds, index_mode: bool, B: int, mode: str):
+        """THE shared chain walk with capacity evolution: yields
+        (step, pat, kind, fold, cap_in, cap_out) mirroring _dispatch's
+        transitions exactly (same _expand_est/_expand_cap/_member_cap
+        helpers, memo-first). cap_out == cap_in for non-compacting steps."""
+        eng = self.eng
+        memo = self._cap_memo.get(self._key(pats, B, mode), {})
+        step_est = {k: e * (1.0 if mode == "slice" else float(B))
+                    for k, e in eng._chain_estimates(pats).items()}
+        if index_mode:
+            p0 = pats[0]
+            real = len(eng.g.get_index(p0.subject, p0.direction))
+            total0 = real if mode == "slice" else real * B
+            cap = K.next_capacity(max(total0, 1), eng.cap_min, eng.cap_max)
+            est_rows = float(max(total0, 1))
+        else:
+            cap = K.next_capacity(B, eng.cap_min)
+            est_rows = float(B)
+        for k, pat, kind, fold in self.classify(pats, folds, index_mode):
+            if kind == "expand":
+                est = self._expand_est(pat, k, fold, step_est, est_rows)
+                cap_out = self._expand_cap(k, est, memo)
+                est_rows = max(min(est, cap_out), 1.0)
+                yield k, pat, kind, fold, cap, cap_out
+                cap = cap_out
+            else:
+                cap_new = self._member_cap(k, step_est, memo)
+                if cap_new is not None and cap_new < cap:
+                    yield k, pat, kind, fold, cap, cap_new
+                    cap = cap_new
+                    est_rows = max(min(est_rows, cap_new), 1.0)
+                else:
+                    yield k, pat, kind, fold, cap, cap
+
     @classmethod
     def _chain_pins(cls, pats, folds, index_mode: bool) -> list:
-        """The EXACT DeviceStore keys the planned chain will stage, so pins
-        protect what actually runs: folded expands use ("mrgf", pid, d, fkey)
+        """The DeviceStore keys the planned chain may stage, so pins protect
+        what actually runs: folded expands use ("mrgf"/"segf", pid, d, fkey)
         filtered segments and k2c membership uses ("rev", ...) const lists —
-        pinning only ("mrg", ...) left those evictable under budget pressure,
-        forcing a host rebuild + device_put on every call (advisor r2 #2)."""
+        pinning only ("mrg", ...) left those evictable under budget
+        pressure, forcing a host rebuild + device_put on every call (advisor
+        r2 #2). Expands pin BOTH the merge form and the bucket form: the
+        sort-vs-probe decision runs on the LIVE capacity class inside
+        _dispatch (which can shift across overflow retries and ragged
+        window batches), and pinning an unstaged key costs nothing — only
+        whichever form the chain stages is actually held."""
+        from wukong_tpu.engine.device_store import fold_key
+
         pins = []
         seen = set()
 
@@ -419,11 +477,12 @@ class MergeExecutor:
             pid, d, end = int(pat.predicate), int(pat.direction), pat.object
             if kind == "expand":
                 if fold is not None:
-                    from wukong_tpu.engine.device_store import fold_key
-
-                    add(("mrgf", pid, d, fold_key(fold[0])))
+                    fkey = fold_key(fold[0])
+                    add(("mrgf", pid, d, fkey))
+                    add(("segf", pid, d, fkey))
                 else:
                     add(("mrg", pid, d))
+                    add((pid, d))
             elif kind == "k2k":
                 add(("mrg", pid, d))
             else:
@@ -538,7 +597,14 @@ class MergeExecutor:
 
         e_known = end < 0 and end in state.var_level
         if end < 0 and not e_known:  # expand
-            if fold_filters is not None:
+            # sort-vs-probe lookup dispatch on the LIVE frontier capacity
+            # (matches _walk_caps' cap_in when learning is settled)
+            use_probe = self._probe_lookup_wins(state.cap, pid, d)
+            if use_probe:
+                seg = (eng.dstore.filtered_segment(pid, d, fold_filters[0])
+                       if fold_filters is not None
+                       else eng.dstore.segment(pid, d))
+            elif fold_filters is not None:
                 seg = eng.dstore.filtered_merge_segment(pid, d,
                                                         fold_filters[0])
             else:
@@ -561,7 +627,19 @@ class MergeExecutor:
             state.est_rows = max(min(est, cap_out), 1.0)
             from wukong_tpu.engine import tpu_stream
 
-            if tpu_stream.want_stream(est, int(seg.edges.shape[0]), cap_out):
+            if use_probe:
+                from wukong_tpu.engine.tpu import TPUEngine
+
+                up = K.want_pallas(seg.bkey, state.cap)
+                fd = TPUEngine._fp_dup(seg, up)
+                vals, parent, n, total = K.probe_expand(
+                    seg.bkey, seg.bstart, seg.bdeg, seg.edges, cur,
+                    state.n, state.live_mask(), cap_out=cap_out,
+                    max_probe=seg.max_probe, use_pallas=up,
+                    fpw0=seg.fpw0 if fd else None,
+                    fpw1=seg.fpw1 if fd else None, fp_dup=fd)
+            elif tpu_stream.want_stream(est, int(seg.edges.shape[0]),
+                                        cap_out):
                 # dense expansion: stream the edge array through VMEM
                 # (~3 ns/edge) instead of the per-output scatter+gather
                 # (~25 ns/out); duplicate-anchor frontiers stream through
@@ -638,10 +716,7 @@ class MergeExecutor:
         if not pats or not self.supports(q):
             return None
         index_mode = mode != "const"
-        memo = self._cap_memo.get(self._key(pats, B, mode), {})
         folds = self._plan_folds(pats, index_mode=index_mode)
-        step_est = {k: e * (1.0 if mode == "slice" else float(B))
-                    for k, e in eng._chain_estimates(pats).items()}
         W = 4  # every staged array is int32
 
         def seg_arrays(key, pid, d):
@@ -673,34 +748,34 @@ class MergeExecutor:
             p0 = pats[0]
             real = len(eng.g.get_index(p0.subject, p0.direction))
             total0 = real if mode == "slice" else real * B
-            cap = K.next_capacity(max(total0, 1), eng.cap_min, eng.cap_max)
+            cap0 = K.next_capacity(max(total0, 1), eng.cap_min, eng.cap_max)
             seg_b += list_bytes(("idx", int(p0.subject), int(p0.direction)),
                                 lambda: real)
-            tab_b += W * cap  # init writes the root level
-            est_rows = float(max(total0, 1))
+            tab_b += W * cap0  # init writes the root level
         else:
-            cap = K.next_capacity(B, eng.cap_min)
-            tab_b += W * cap
-            est_rows = float(B)
-        for k, pat, kind, fold in self.classify(pats, folds, index_mode):
+            tab_b += W * K.next_capacity(B, eng.cap_min)
+        from wukong_tpu.engine.device_store import fold_key
+
+        for k, pat, kind, fold, cap, cap_out in self._walk_caps(
+                pats, folds, index_mode, B, mode):
             pid, d, end = int(pat.predicate), int(pat.direction), pat.object
             if kind == "expand":
-                # merge_expand / stream_expand read skey+sstart+sdeg+edges
-                # (ekey stays untouched on the expand path)
-                if fold is not None:
-                    from wukong_tpu.engine.device_store import fold_key
-
-                    nk, ne = seg_arrays(("mrgf", pid, d, fold_key(fold[0])),
-                                        pid, d)
+                if self._probe_lookup_wins(cap, pid, d):
+                    # bucket probe: ~2 bucket rows (3 arrays) per frontier
+                    # row + one gather per emitted edge — the whole point
+                    # of the probe path is NOT streaming the segment
+                    seg_b += W * (6 * cap + cap_out)
                 else:
-                    nk, ne = seg_arrays(("mrg", pid, d), pid, d)
-                seg_b += W * (3 * nk + ne)
-                est = self._expand_est(pat, k, fold, step_est, est_rows)
-                cap_out = self._expand_cap(k, est, memo)
-                est_rows = max(min(est, cap_out), 1.0)
+                    # merge_expand / stream_expand read skey+sstart+sdeg+
+                    # edges (ekey stays untouched on the expand path)
+                    if fold is not None:
+                        nk, ne = seg_arrays(
+                            ("mrgf", pid, d, fold_key(fold[0])), pid, d)
+                    else:
+                        nk, ne = seg_arrays(("mrg", pid, d), pid, d)
+                    seg_b += W * (3 * nk + ne)
                 # read the anchor column, write (vals, parent)
                 tab_b += W * (cap + 2 * cap_out)
-                cap = cap_out
                 continue
             if kind == "k2k":
                 # merge_member_pairs reads only the (ekey, edges) pair
@@ -714,10 +789,7 @@ class MergeExecutor:
                     lambda pid=pid, d=d, end=end: len(
                         eng.dstore._const_members(pid, d, end)))
                 tab_b += W * cap + cap  # one column read + bool mask
-            cap_new = self._member_cap(k, step_est, memo)
-            if cap_new is not None and cap_new < cap:
-                tab_b += W * 2 * cap_new  # compact writes (vals, parent)
-                cap = cap_new
-                est_rows = max(min(est_rows, cap_new), 1.0)
+            if cap_out < cap:
+                tab_b += W * 2 * cap_out  # compact writes (vals, parent)
         return {"segment_bytes": int(seg_b), "table_bytes": int(tab_b),
                 "total_bytes": int(seg_b + tab_b)}
