@@ -332,3 +332,30 @@ def test_probe_lookup_path_matches(world, qfile, monkeypatch):
     # and the traffic model prices the probe path (no full-segment stream)
     bm = tpu.merge.bytes_model(q, B, mode)
     assert bm is not None and bm["total_bytes"] > 0
+
+
+def test_run_batch_const_mixed_cross_class(engines, world):
+    """ONE flight spanning DIFFERENT templates (the emulator's cross-class
+    window): counts must match the per-class sequential path, including
+    when a job in the flight overflows (slow-path redo) and when a
+    planner-empty or merge-unsupported job is mixed in via the engine
+    wrapper."""
+    _, tpu = engines
+    g, ss = world
+    jobs = []
+    want = []
+    for qn in ("lubm_q4", "lubm_q5", "lubm_q6"):
+        q = _parse(ss, f"{BASIC}/{qn}")
+        const = q.pattern_group.patterns[0].subject
+        consts = np.full(4, const, dtype=np.int64)
+        want.append(tpu.execute_batch(q, consts).tolist())  # learns caps
+        jobs.append((q, consts))
+    got = tpu.merge.run_batch_const_mixed(jobs)
+    assert [r.tolist() for r in got] == want
+    # cold-memo flight: redo path must still produce exact counts
+    tpu.merge._cap_memo.clear()
+    got = tpu.merge.run_batch_const_mixed(jobs)
+    assert [r.tolist() for r in got] == want
+    # engine wrapper: same jobs through execute_batch_mixed
+    got = tpu.execute_batch_mixed(jobs)
+    assert [r.tolist() for r in got] == want

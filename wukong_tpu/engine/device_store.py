@@ -251,6 +251,7 @@ class DeviceStore:
             self._index_cache.clear()
             self._lru.clear()
             self.bytes_used = 0
+            self.__dict__.pop("_fcsr_memo", None)  # filtered-CSR host memo
             self._seen_version = v
 
     def segment(self, pid: int, d: int) -> DeviceSegment | None:
@@ -385,7 +386,21 @@ class DeviceStore:
     def _filtered_host_csr(self, pid: int, d: int, fkey: tuple):
         """Host CSR of (pid, d) with edges restricted to targets satisfying
         every (fpid, fd, fconst) k2c filter — shared by the merge-form and
-        bucket-form filtered stagings. O(E log M) searchsorted membership."""
+        bucket-form filtered stagings. O(E log M) searchsorted membership,
+        memoized per (pid, d, fkey): a sort-vs-probe flip during capacity
+        learning stages BOTH forms, and the scan must not run twice."""
+        memo_key = (int(pid), int(d), fkey)
+        if not hasattr(self, "_fcsr_memo"):
+            self._fcsr_memo = {}
+        if memo_key in self._fcsr_memo:
+            return self._fcsr_memo[memo_key]
+        csr = self._filtered_host_csr_build(pid, d, fkey)
+        if len(self._fcsr_memo) > 64:  # bound the HOST-side copies
+            self._fcsr_memo.clear()
+        self._fcsr_memo[memo_key] = csr
+        return csr
+
+    def _filtered_host_csr_build(self, pid: int, d: int, fkey: tuple):
         csr = self._host_csr(pid, d)
         if csr is None:
             return None

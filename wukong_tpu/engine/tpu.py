@@ -523,6 +523,28 @@ class TPUEngine:
             return self.merge.run_batch_const_many(q, consts_list)
         return [self.execute_batch(q, c) for c in consts_list]
 
+    def execute_batch_mixed(self, jobs: list) -> list:
+        """One device flight across MULTIPLE const-start templates (the
+        cross-class window): jobs = [(query, consts), ...]. Planner-empty
+        jobs answer instantly; merge-supported jobs share ONE sync via
+        run_batch_const_mixed; the rest degrade to per-job execute_batch.
+        Returns per-job count arrays in input order."""
+        out: list = [None] * len(jobs)
+        mixed = []
+        for i, (q, consts) in enumerate(jobs):
+            self._check_batch_const(q)
+            if q.planner_empty and Global.enable_empty_shortcircuit:
+                out[i] = np.zeros(len(consts), dtype=np.int64)
+            elif Global.enable_merge_join and self.merge.supports(q):
+                mixed.append(i)
+            else:
+                out[i] = self.execute_batch(q, consts)
+        if mixed:
+            res = self.merge.run_batch_const_mixed([jobs[i] for i in mixed])
+            for i, r in zip(mixed, res):
+                out[i] = r
+        return out
+
     def execute_batch_index(self, q: SPARQLQuery, B: int,
                             slice_mode: bool = False) -> np.ndarray:
         """Batched execution of an index-origin (heavy) query.

@@ -276,32 +276,78 @@ class MergeExecutor:
         return self._run_many(pats, False, consts_list, dispatch_one,
                               lambda consts: self.run_batch_const(q, consts))
 
-    def _run_many(self, pats, index_mode: bool, specs: list, dispatch_one,
-                  slow_one) -> list:
-        """THE single in-flight-window scaffold: pin once, dispatch every
-        batch back-to-back, device_get the whole flight in one sync, and
-        re-run overflowing batches individually via `slow_one` (which
-        retries internally and re-learns capacities for later windows)."""
+    def run_batch_const_mixed(self, jobs: list) -> list:
+        """ONE device flight spanning MULTIPLE const-start templates — the
+        cross-CLASS in-flight window (proxy.hpp:477-525's open loop
+        interleaves classes freely; per-class windows left sync
+        amortization on the table whenever the mix rotates templates).
+        Segments shared between templates are pinned/staged once. Requires
+        learned capacities per (query, B) — batches that still overflow
+        re-run individually through run_batch_const."""
+        per = []
+        pin_set = []
+        for q, consts in jobs:
+            pats = q.pattern_group.patterns
+            folds = self._plan_folds(pats, index_mode=False)
+            pin_set.extend(self._chain_pins(pats, folds, index_mode=False))
+            per.append((q, consts, pats, folds))
+
+        def mk_thunk(q, consts, pats, folds):
+            def thunk():
+                cap_override = dict(self._cap_memo.get(
+                    self._key(pats, len(consts), "const"), {}))
+                state = _MergeState()
+                self._init_const(state, pats, consts)
+                for k, pat, _kind, fold in self.classify(
+                        pats, folds, index_mode=False):
+                    self._dispatch(q, pat, k, state, cap_override, {}, fold)
+                counts = K.qid_counts_pos0(state.pos0(), state.n,
+                                           state.live_mask(),
+                                           B=len(consts), r=1,
+                                           slice_mode=False)
+                return counts, state.totals
+            return thunk
+
+        return self._flight(
+            pin_set,
+            [mk_thunk(*p) for p in per],
+            [lambda q=q, c=c: self.run_batch_const(q, c)
+             for (q, c, _p, _f) in per])
+
+    def _flight(self, pin_set, thunks, slows) -> list:
+        """THE single in-flight-window protocol: pin, dispatch every chain
+        back-to-back, device_get the whole flight in ONE sync, redo
+        overflowing entries via their slow thunk (which retries internally
+        and re-learns capacities for later windows)."""
         import jax
 
         eng = self.eng
-        folds = self._plan_folds(pats, index_mode=index_mode)
-        pins = self._chain_pins(pats, folds, index_mode=index_mode)
-        eng.dstore.pin(pins)
+        eng.dstore.pin(pin_set)
         try:
-            flight = [dispatch_one(spec, folds) for spec in specs]
+            flight = [t() for t in thunks]
             payload = [(c, [t for (_, t, _) in tot]) for c, tot in flight]
             host = jax.device_get(payload)
         finally:
-            eng.dstore.unpin(pins)
+            eng.dstore.unpin(pin_set)
         out = []
-        for (spec, (host_counts, totals), (_, tot)) in zip(
-                specs, host, flight):
+        for (slow, (host_counts, totals), (_, tot)) in zip(
+                slows, host, flight):
             if any(int(t) > c for (_, _, c), t in zip(tot, totals)):
-                out.append(slow_one(spec))  # slow path
+                out.append(slow())
             else:
                 out.append(np.asarray(host_counts))
         return out
+
+    def _run_many(self, pats, index_mode: bool, specs: list, dispatch_one,
+                  slow_one) -> list:
+        """Single-template in-flight window over the shared _flight
+        protocol: one pin set, one folds plan, K batches of one chain."""
+        folds = self._plan_folds(pats, index_mode=index_mode)
+        pins = self._chain_pins(pats, folds, index_mode=index_mode)
+        return self._flight(
+            pins,
+            [lambda spec=spec: dispatch_one(spec, folds) for spec in specs],
+            [lambda spec=spec: slow_one(spec) for spec in specs])
 
     def _init_const(self, state: "_MergeState", pats, consts) -> None:
         import jax.numpy as jnp

@@ -80,6 +80,10 @@ class DistEngine:
         # their capacity classes, and how many whole-chain retries were paid
         self.last_chain_stats: dict | None = None
         self._last_plan: _Plan | None = None
+        # one-shot dryrun hook: seed the NEXT chain's capacity overrides
+        # (e.g. an undersized class) to exercise the overflow-retry path
+        # deterministically; consumed and cleared by _run_device_bgp
+        self.force_cap_override: dict | None = None
 
     # ------------------------------------------------------------------
     def execute(self, q: SPARQLQuery, from_proxy: bool = True) -> SPARQLQuery:
@@ -246,7 +250,8 @@ class DistEngine:
 
     # ------------------------------------------------------------------
     def _run_device_bgp(self, q: SPARQLQuery, n_steps: int, seed=None) -> None:
-        cap_override: dict[int, int] = {}
+        cap_override: dict = dict(self.force_cap_override or {})
+        self.force_cap_override = None
         seed_cache: dict = {}  # seed shards are retry-invariant; transfer once
         for _attempt in range(8):
             plan = self._build_plan(q, cap_override, n_steps, seed)

@@ -105,6 +105,8 @@ class Emulator:
             q._heavy_b = 0  # lazily-computed device batch size
             planned.append(("heavy", None, q))
 
+        self._planned = planned
+        self._probs = probs
         self.monitor.start_thpt()
         t_end = get_usec() + int((duration_s + warmup_s) * 1e6)
         t_measure = get_usec() + int(warmup_s * 1e6)
@@ -187,28 +189,58 @@ class Emulator:
         if kind == "light" and self._batchable(tmpl, q0):
             tpu = self.proxy.tpu
             # once the class's first batch has learned its capacities, ride
-            # the in-flight window: W batches through execute_batch_many
-            # (one device sync on the merge path), so the ~45-70 ms sync
-            # amortizes over W*B queries — the device path's honoring of
-            # the `-p` in-flight cap (round-2 Weak #6 / ROADMAP debt)
+            # the in-flight window: W batches in one device flight, so the
+            # ~45-70 ms sync amortizes over W*B queries — the device path's
+            # honoring of the `-p` in-flight cap (round-2 Weak #6). The
+            # window draws from ALL warm batchable light classes by mix
+            # weight (proxy.hpp:477-525's open loop interleaves classes
+            # freely), not W copies of one class — one sync serves the mix.
             W = 1
             if getattr(q0, "_many_warm", False) and self._p_cap > 1:
                 W = min(self._p_cap, 8)  # bound live batch tables
             t0 = get_usec()
+            if W > 1:
+                pool_cls = [c for c, (k2, t2, p2) in
+                            enumerate(self._planned)
+                            if k2 == "light"
+                            and getattr(p2, "_many_warm", False)
+                            and self._batchable(t2, p2)
+                            and tpu.merge.supports(p2)]
+                if cls not in pool_cls:
+                    pool_cls = [cls]
+                w = self._probs[pool_cls] / self._probs[pool_cls].sum()
+                draws = [int(c) for c in rng.choice(pool_cls, size=W, p=w)]
+                if cls not in draws:
+                    draws[0] = cls  # the chosen class always rides
+                jobs = [(self._planned[c][2],
+                         self._draw_consts(self._planned[c][1], rng, B))
+                        for c in draws]
+                try:
+                    tpu.execute_batch_mixed(jobs)
+                except (WukongError, RuntimeError):
+                    # the failure could come from ANY drawn class's chain —
+                    # de-warm them ALL (each re-warms through its own
+                    # single-class batch, where a genuinely bad class fails
+                    # alone and is disabled with correct blame) instead of
+                    # permanently disabling the chosen class on a possibly
+                    # innocent verdict
+                    for c in set(draws):
+                        self._planned[c][2]._many_warm = False
+                    return False
+                dt_q = (get_usec() - t0) / (B * W)
+                for c in set(draws):
+                    self.monitor.add_latency(
+                        dt_q, qtype=c, count=B * draws.count(c))
+                    self.class_mode[c] = "device-batch"
+                return True
             try:
-                if W > 1:
-                    tpu.execute_batch_many(
-                        q0, [self._draw_consts(tmpl, rng, B)
-                             for _ in range(W)])
-                    served = B * W
-                else:
-                    tpu.execute_batch(q0, self._draw_consts(tmpl, rng, B))
-                    q0._many_warm = True
-                    served = B
+                tpu.execute_batch(q0, self._draw_consts(tmpl, rng, B))
+                q0._many_warm = True
+                served = B
             except (WukongError, RuntimeError):
                 # RuntimeError covers XLA RESOURCE_EXHAUSTED from the
-                # window's W-fold in-flight footprint — degrade to the pool
-                # rather than aborting the run
+                # batch footprint — degrade this class to the pool rather
+                # than aborting the run
                 q0._inst_const = None  # disables _batchable next rounds
                 return False
             self.monitor.add_latency((get_usec() - t0) / served, qtype=cls,
