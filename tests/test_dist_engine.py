@@ -615,3 +615,42 @@ def test_dist_versatile_const_shapes(world):
     # continuation after the fold
     cmp([(fp, TYPE_ID, IN, -1), (-1, -9, OUT, univ0), (-1, works, OUT, -2)],
         [-1, -9, -2], "k_u_c_then_expand")
+
+
+def test_learned_caps_tighten_steady_state(world):
+    """Successful chains record EXACT capacity classes per pattern key: the
+    second run of an exchange-bearing query compiles at capacities no
+    larger than (usually far below) the estimate-driven first run, with
+    identical results; an injected undersized class still self-corrects
+    through the overflow retry."""
+    ss, cpu, dist = world
+    dist._learned_caps.clear()
+    text = open(f"{BASIC}/lubm_q7").read()
+
+    def run():
+        q = Parser(ss).parse(text)
+        heuristic_plan(q)
+        q.result.blind = True
+        dist.execute(q, from_proxy=False)
+        assert q.result.status_code == 0
+        return q.result.nrows, dist.last_chain_stats
+
+    rows1, st1 = run()
+    assert dist._learned_caps  # learning happened
+    rows2, st2 = run()
+    assert rows2 == rows1
+    caps1 = [s["cap"] for s in st1["steps"]]
+    caps2 = [s["cap"] for s in st2["steps"]]
+    assert all(c2 <= c1 for c1, c2 in zip(caps1, caps2))
+    ex1 = [s["exch_cap"] for s in st1["steps"] if "exch_cap" in s]
+    ex2 = [s["exch_cap"] for s in st2["steps"] if "exch_cap" in s]
+    assert ex1 and all(c2 <= c1 for c1, c2 in zip(ex1, ex2))
+    # run 2's classes are exact: every load fits its (tight) class
+    for s in st2["steps"]:
+        assert s["rows_peak_shard"] <= s["cap"]
+        if "exch_cap" in s:
+            assert s["exch_peak_dest"] <= s["exch_cap"]
+    # undersized injection on a LEARNED chain: retry restores correctness
+    dist.force_cap_override = {("cap", 1): 2}
+    rows3, st3 = run()
+    assert rows3 == rows1 and st3["retries"] >= 1

@@ -383,6 +383,16 @@ class DeviceStore:
         host = self.g.segments.get((int(pid), int(d)))
         return host.num_keys if host is not None else 0
 
+    def host_num_edges(self, pid: int, d: int) -> int:
+        """Edge count of a (pid, dir) segment from HOST metadata only (the
+        membership sort-vs-probe dispatch: merge_member_pairs sorts the
+        whole per-edge pair arrays)."""
+        self._check_version()
+        if int(pid) == TYPE_ID and int(d) == IN:
+            return sum(len(self.g.get_index(t, IN)) for t in self.g.type_ids)
+        host = self.g.segments.get((int(pid), int(d)))
+        return host.num_edges if host is not None else 0
+
     def _filtered_host_csr(self, pid: int, d: int, fkey: tuple):
         """Host CSR of (pid, d) with edges restricted to targets satisfying
         every (fpid, fd, fconst) k2c filter — shared by the merge-form and

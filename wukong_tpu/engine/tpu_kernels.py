@@ -662,6 +662,27 @@ def merge_member_list(sorted_list, real_len, cur, n, live):
 
 
 @jax.jit
+def member_list_binsearch(sorted_list, real_len, cur, n, live):
+    """k2c membership for SMALL frontiers: binary-search each row in the
+    sorted const list (O(C log L) sorted gathers) instead of merge-sorting
+    the whole list with the frontier (merge_member_list pays
+    O((L + C) log) per call — at LUBM-2560 a 2^22-member type list
+    re-sorts for a 16K-row frontier). Returns a bool mask in INPUT row
+    order; search depth derives from the list's padded length (static
+    shape)."""
+    L = sorted_list.shape[0]
+    depth = max(int(L - 1).bit_length(), 1)
+    C = cur.shape[0]
+    rows = jnp.arange(C, dtype=jnp.int32)
+    ok_row = (rows < n) & live
+    curm = jnp.where(ok_row, cur, INT32_MAX)
+    lo = jnp.zeros(C, jnp.int32)
+    hi = jnp.broadcast_to(real_len.astype(jnp.int32), (C,))
+    ok = _range_member(sorted_list, lo, hi, curm, depth)
+    return ok & ok_row
+
+
+@jax.jit
 def merge_member_pairs(ekey, eval_, e_real, cur, vals, n, live):
     """known_to_known: does edge (cur[i] -> vals[i]) exist? ekey/eval_ are the
     segment's per-edge (key, neighbor) pairs, lex-sorted (CSR order). Returns

@@ -463,6 +463,13 @@ class MergeExecutor:
         return (self.eng.dstore.host_num_keys(pid, d)
                 >= cap_in * self.PROBE_LOOKUP_FACTOR)
 
+    def _probe_member_wins(self, cap_in: int, pid: int, d: int) -> bool:
+        """Membership twin of _probe_lookup_wins: merge_member_pairs sorts
+        the per-EDGE pair arrays, so the dispatch scalar is the edge
+        count."""
+        return (self.eng.dstore.host_num_edges(pid, d)
+                >= cap_in * self.PROBE_LOOKUP_FACTOR)
+
     def _walk_caps(self, pats, folds, index_mode: bool, B: int, mode: str):
         """THE shared chain walk with capacity evolution: yields
         (step, pat, kind, fold, cap_in, cap_out) mirroring _dispatch's
@@ -531,6 +538,7 @@ class MergeExecutor:
                     add((pid, d))
             elif kind == "k2k":
                 add(("mrg", pid, d))
+                add((pid, d))  # bucket twin for the probe-member arm
             else:
                 add(("rev", pid, d, int(end)))
         return pins
@@ -708,20 +716,45 @@ class MergeExecutor:
             state.totals.append((step, total, cap_out))
             return
 
-        # membership: known_to_const / known_to_known
+        # membership: known_to_const / known_to_known — each with its own
+        # small-frontier arm (merge_member_* re-sorts the whole relation
+        # per call; probe/binary-search touches O(frontier) instead)
         if e_known:
-            seg = eng.dstore.merge_segment(pid, d)
-            if seg is None:
-                keep = jnp.zeros(state.cap, dtype=bool)
+            if self._probe_member_wins(state.cap, pid, d):
+                seg = eng.dstore.segment(pid, d)
+                if seg is None:
+                    keep = jnp.zeros(state.cap, dtype=bool)
+                else:
+                    from wukong_tpu.engine.tpu import TPUEngine
+
+                    vals = state.materialize(end)
+                    up = K.want_pallas(seg.bkey, state.cap)
+                    fd = TPUEngine._fp_dup(seg, up)
+                    keep = K.member_mask_known(
+                        cur[None, :], state.n, vals, seg.bkey, seg.bstart,
+                        seg.bdeg, seg.edges, col=0,
+                        max_probe=seg.max_probe, depth=seg.max_deg_log2,
+                        use_pallas=up,
+                        fpw0=seg.fpw0 if fd else None,
+                        fpw1=seg.fpw1 if fd else None,
+                        fp_dup=fd) & state.live_mask()
             else:
-                vals = state.materialize(end)
-                keep = K.merge_member_pairs(
-                    seg.ekey, seg.edges, jnp.int32(seg.num_edges),
-                    cur, vals, state.n, state.live_mask())
+                seg = eng.dstore.merge_segment(pid, d)
+                if seg is None:
+                    keep = jnp.zeros(state.cap, dtype=bool)
+                else:
+                    vals = state.materialize(end)
+                    keep = K.merge_member_pairs(
+                        seg.ekey, seg.edges, jnp.int32(seg.num_edges),
+                        cur, vals, state.n, state.live_mask())
         else:
             rev, real = eng.dstore.const_list(pid, d, end)
-            keep = K.merge_member_list(rev, jnp.int32(real), cur,
-                                       state.n, state.live_mask())
+            if real >= state.cap * self.PROBE_LOOKUP_FACTOR:
+                keep = K.member_list_binsearch(rev, jnp.int32(real), cur,
+                                               state.n, state.live_mask())
+            else:
+                keep = K.merge_member_list(rev, jnp.int32(real), cur,
+                                           state.n, state.live_mask())
         cap_new = self._member_cap(step, step_est, cap_override)
         if cap_new is not None and cap_new < state.cap:
             top = state.levels[-1]
@@ -824,16 +857,28 @@ class MergeExecutor:
                 tab_b += W * (cap + 2 * cap_out)
                 continue
             if kind == "k2k":
-                # merge_member_pairs reads only the (ekey, edges) pair
-                # arrays, plus the two bound columns
-                _nk, ne = seg_arrays(("mrg", pid, d), pid, d)
-                seg_b += W * 2 * ne
+                if self._probe_member_wins(cap, pid, d):
+                    # bucket probe + per-row binary search: ~2 bucket rows
+                    # (3 arrays) + ~depth edge gathers per frontier row
+                    seg_b += W * cap * (6 + 32)
+                else:
+                    # merge_member_pairs reads only the (ekey, edges) pair
+                    # arrays
+                    _nk, ne = seg_arrays(("mrg", pid, d), pid, d)
+                    seg_b += W * 2 * ne
                 tab_b += W * 2 * cap + cap  # two columns read + bool mask
-            else:  # k2c: merge_member_list reads the list + one column
-                seg_b += list_bytes(
-                    ("rev", pid, d, int(end)),
-                    lambda pid=pid, d=d, end=end: len(
-                        eng.dstore._const_members(pid, d, end)))
+            else:  # k2c
+                key = ("rev", pid, d, int(end))
+                ent = eng.dstore._index_cache.get(key)
+                # REAL length decides, exactly as _dispatch does (the
+                # staged array is pow2-padded; deciding on the pad would
+                # flip the modeled branch with cache state)
+                real = (int(ent[1]) if ent is not None else len(
+                    eng.dstore._const_members(pid, d, end)))
+                if real >= cap * self.PROBE_LOOKUP_FACTOR:
+                    seg_b += W * cap * 32  # binary-search gathers
+                else:
+                    seg_b += list_bytes(key, lambda: real)
                 tab_b += W * cap + cap  # one column read + bool mask
             if cap_out < cap:
                 tab_b += W * 2 * cap_out  # compact writes (vals, parent)

@@ -84,13 +84,23 @@ class DistEngine:
         # (e.g. an undersized class) to exercise the overflow-retry path
         # deterministically; consumed and cleared by _run_device_bgp
         self.force_cap_override: dict | None = None
+        # learned capacity classes per pattern-chain key: estimate-driven
+        # first runs over-pad (the skew bound is conservative by design —
+        # BENCH_DIST_r04 measured q1 shipping 335 MB of PADDED all-to-all
+        # per chain against ~16x-smaller real peaks), so successful runs
+        # record the EXACT classes and steady-state chains recompile once
+        # at tight capacities; undersized learning self-corrects through
+        # the normal overflow retry
+        self._learned_caps: dict = {}
 
     # ------------------------------------------------------------------
     def execute(self, q: SPARQLQuery, from_proxy: bool = True) -> SPARQLQuery:
         if self.sstore.check_version():
             # compiled chains bake per-segment max_probe/depth — stale after
-            # dynamic inserts (dynamic_gstore.hpp lease invalidation analogue)
+            # dynamic inserts (dynamic_gstore.hpp lease invalidation analogue);
+            # learned capacity classes measured the old data
             self._fn_cache.clear()
+            self._learned_caps.clear()
         try:
             self._execute_sm(q, from_proxy)
         except WukongError as e:
@@ -250,7 +260,17 @@ class DistEngine:
 
     # ------------------------------------------------------------------
     def _run_device_bgp(self, q: SPARQLQuery, n_steps: int, seed=None) -> None:
-        cap_override: dict = dict(self.force_cap_override or {})
+        pats_key = tuple(
+            (p.subject, p.predicate, int(p.direction), p.object)
+            for p in q.pattern_group.patterns[
+                q.pattern_step:q.pattern_step + n_steps])
+        # learned caps apply only to unseeded chains, symmetric with the
+        # write below: a seeded plan prepends init_rows (shifting every
+        # step index) and carries a different parent table's cardinalities
+        cap_override: dict = (dict(self._learned_caps.get(pats_key, {}))
+                              if seed is None else {})
+        if self.force_cap_override:
+            cap_override.update(self.force_cap_override)
         self.force_cap_override = None
         seed_cache: dict = {}  # seed shards are retry-invariant; transfer once
         for _attempt in range(8):
@@ -315,6 +335,23 @@ class DistEngine:
                                                   if s.exch_cap),
                                  "steps": step_stats}
         self._last_plan = plan
+        # learn EXACT classes for the next run of this chain (tighter
+        # where the estimate over-padded, already-exact where it retried)
+        learned = {}
+        for i, s in enumerate(plan.steps):
+            learned[("cap", i)] = K.next_capacity(
+                max(int(totals[:, i].max()), 1), self.cap_min, self.cap_max)
+            if s.exch_cap:
+                learned[("exch", i)] = K.next_capacity(
+                    max(int(totals[:, S + i].max()), 1),
+                    self.cap_min, self.cap_max)
+        if len(self._learned_caps) > 1024:
+            self._learned_caps.clear()
+        if seed is None:
+            # seeded children sharing a pats key can carry very different
+            # parent tables; learning from one would mis-size the next
+            # (the retry would self-correct, but at a recompile per flip)
+            self._learned_caps[pats_key] = learned
 
         res = q.result
         res.v2c_map = dict(plan.v2c)
