@@ -19,12 +19,14 @@ import jax, jax.numpy as jnp, sys
 jax.device_get(jnp.arange(2) + 1)
 sys.exit(0 if jax.devices()[0].platform != 'cpu' else 1)" >/dev/null 2>&1; then
     # cycle kernel A/Bs so the partial store accumulates comparison points:
-    # default first (the headline), then merge-off, stream-off, mhot-off
-    case $((PASS % 4)) in
+    # default first (the headline), then merge-off, stream-off, mhot-off,
+    # then the heavy-batch HBM trade (2^26-row classes -> bigger B)
+    case $((PASS % 5)) in
       0) AB="" ;;
       1) AB="WUKONG_ENABLE_MERGE=0" ;;
       2) AB="WUKONG_ENABLE_STREAM=0" ;;
       3) AB="WUKONG_ENABLE_STREAM_MHOT=0" ;;
+      4) AB="WUKONG_CAP_MAX=67108864" ;;
     esac
     echo "[$(date +%F' '%T)] backend healthy -> bench @ LUBM-$WUKONG_BENCH_SCALE ${AB:-default}" >> "$LOG"
     env $AB timeout 10800 python bench.py >> "$LOG" 2>&1
