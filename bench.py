@@ -697,13 +697,30 @@ def _measure_one(qn: str, scale: int) -> dict:
     eng.merge.save_cap_memo(memo_path)
     # planner-proved-empty queries short-circuit to ~0; floor at 0.1 us so
     # the geomean stays finite, and FLAG them: the reference's published
-    # number for such a query measured full execution, so a ratio against
-    # it would be inflated ~7x by a query neither engine ran comparably —
-    # the assembly excludes flagged queries from vs_baseline
+    # number for such a query measured full execution, so a raw ratio
+    # would be inflated ~7x by a query neither engine ran comparably —
+    # the assembly counts flagged queries at PARITY (1.0) in vs_baseline
     out = {"us": max(round(best, 1), 0.1), "rows": nrows, "batch": bq,
            "inflight": K}
     if q0.planner_empty:
         out["planner_empty"] = True
+    if os.environ.get("WUKONG_BENCH_BACKEND", "tpu") == "tpu":
+        # kernel capability evidence (round-3 weak #1: a Mosaic lowering
+        # failure silently demotes every dense expand to the XLA emit —
+        # the artifact must SAY whether the stream kernel exists on this
+        # silicon, not leave it to A/B archaeology)
+        try:
+            from wukong_tpu.engine import tpu_kernels, tpu_stream
+
+            out["stream_available"] = bool(tpu_stream.stream_available())
+            out["pallas_probe_available"] = bool(
+                tpu_kernels.pallas_available())
+        except Exception as e:
+            # capability evidence must stay machine-checkable: a probe
+            # CRASH means the kernels are not available
+            out["stream_available"] = False
+            out["pallas_probe_available"] = False
+            out["kernel_probe_error"] = str(e)[:200]
     _attach_roofline(out, eng, q0, bq, "const" if const_start else "rep",
                      os.environ.get("WUKONG_BENCH_BACKEND", "tpu"))
     # capacity-class behavior evidence (the at-scale de-risk artifact):
@@ -1221,6 +1238,7 @@ def main():
     # assemble: per query prefer the best persisted TPU measurement at the
     # target scale (includes this run's, when on-chip) over any CPU fallback
     lat_us, ref_us = [], []  # ref entries for the SAME surviving queries
+    n_parity = 0  # planner-empty queries: ratio 1.0 contributions
     backends_used, scales_used = set(), set()
     partial_store = _load_partial()  # one read serves the whole assembly
     for i, qn in enumerate(queries):
@@ -1242,9 +1260,17 @@ def main():
         backends_used.add(d["backend"])
         scales_used.add(d["scale"])
         if d.get("planner_empty"):
-            # short-circuited here, fully executed in the baseline table:
-            # not a comparable pair — keep in detail, out of both geomeans
-            d["excluded_from_ratio"] = "planner-proved empty (short-circuit)"
+            # short-circuited here; the reference also short-circuits
+            # provably-empty queries (planner.hpp:1505-1509) but its
+            # PUBLISHED number measured full execution — not a comparable
+            # pair. Round-4 verdict weak #5: count the query at PARITY in
+            # the ratio (contributes 1.0) instead of dropping it, and keep
+            # it out of the displayed latency geomean (a ~0.1 us entry
+            # would deflate the value without information).
+            d["ratio_parity"] = ("planner-proved empty: counted at 1.0 in "
+                                 "vs_baseline, excluded from the latency "
+                                 "geomean")
+            n_parity += 1
             continue
         lat_us.append(d["us"])
         ref_us.append(REF_GPU_LUBM2560[i])
@@ -1253,6 +1279,11 @@ def main():
 
     ours = _geomean(lat_us)
     ref = _geomean(ref_us)
+    # ratio over ALL surviving queries: comparable pairs contribute
+    # ref/ours, planner-empty pairs contribute exactly 1.0 — algebraically
+    # the comparable-set ratio raised to its share of the query count
+    n_ratio = len(lat_us) + n_parity
+    ratio = float((ref / ours) ** (len(lat_us) / max(n_ratio, 1)))
     backend = ("tpu" if backends_used == {"tpu"}
                else "cpu" if backends_used == {"cpu"} else "mixed")
     scale_str = "/".join(str(s) for s in sorted(scales_used))
@@ -1278,17 +1309,17 @@ def main():
 
     excl = [qn for qn in queries
             if isinstance(details.get(qn), dict)
-            and details[qn].get("excluded_from_ratio")]
+            and details[qn].get("ratio_parity")]
     print(json.dumps({
         "metric": f"LUBM-{scale_str} L1-L7 geomean latency, {label}, blind,"
                   f" all queries batched (lights x{BATCH}, heavies x fit;"
                   f" baseline: reference CUDA engine @ LUBM-2560)"
-                  + (f"; planner-empty, excluded: {','.join(excl)}"
-                     if excl else "")
+                  + (f"; planner-empty, at parity in ratio, out of the "
+                     f"latency geomean: {','.join(excl)}" if excl else "")
                   + (f"; FAILED: {','.join(failed)}" if failed else ""),
         "value": round(ours, 1),
         "unit": "us",
-        "vs_baseline": round(ref / ours, 3) if comparable else None,
+        "vs_baseline": round(ratio, 3) if comparable else None,
         "backend": backend,
         **({} if default_toggles else {"toggles": _toggles_key()}),
         "detail": details,
