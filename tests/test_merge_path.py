@@ -359,3 +359,73 @@ def test_run_batch_const_mixed_cross_class(engines, world):
     # engine wrapper: same jobs through execute_batch_mixed
     got = tpu.execute_batch_mixed(jobs)
     assert [r.tolist() for r in got] == want
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_probe_vs_merge_arm_fuzz(seed, monkeypatch):
+    """Differential fuzz of the lookup-dispatch arms on random worlds:
+    the SAME random chain through (a) every expand/member forced onto the
+    probe/binary-search arms and (b) every step forced onto the sort-merge
+    arms must agree with each other AND with the independent BGP oracle.
+    Random shapes cover expand-expand, expand-k2c, and k2k back-edges
+    (LUBM's fixed shapes never vary the dispatch boundary)."""
+    from tests.bgp_oracle import TripleIndex, eval_bgp
+    from wukong_tpu.engine.tpu_merge import MergeExecutor
+    from wukong_tpu.loader.generic_rdf import generate_generic
+    from wukong_tpu.sparql.ir import Pattern, SPARQLQuery
+    from wukong_tpu.types import IN, OUT, TYPE_ID
+
+    rng = np.random.default_rng(4200 + seed)
+    triples, meta = generate_generic(4000, n_preds=6, n_types=3,
+                                     seed=100 + seed)
+    g = build_partition(triples, 0, 1)
+    pids = [int(p) for p in np.unique(triples[:, 1]) if p != TYPE_ID]
+    types = sorted(g.type_ids)
+    tid = int(rng.choice(types))
+    p1, p2 = (int(x) for x in rng.choice(pids, 2, replace=False))
+    d1, d2 = int(rng.integers(2)), int(rng.integers(2))
+    shape = int(rng.integers(3))
+    pats = [Pattern(tid, TYPE_ID, IN, -1), Pattern(-1, p1, d1, -2)]
+    if shape == 0:
+        pats.append(Pattern(-2, p2, d2, -3))
+        nv = 3
+    elif shape == 1:  # k2c on the root var: a real const filter
+        seg = g.segments.get((p2, OUT))
+        const = (int(np.asarray(seg.edges)[rng.integers(seg.num_edges)])
+                 if seg is not None and seg.num_edges else int(types[0]))
+        pats.append(Pattern(-1, p2, OUT, const))
+        nv = 2
+    else:  # k2k back-edge
+        pats.append(Pattern(-2, p2, d2, -1))
+        nv = 2
+
+    def mk():
+        q = SPARQLQuery()
+        q.pattern_group.patterns = [Pattern(p.subject, p.predicate,
+                                            p.direction, p.object)
+                                    for p in pats]
+        q.result.nvars = nv
+        q.result.required_vars = [-(i + 1) for i in range(nv)]
+        q.result.blind = True
+        return q
+
+    B = 3
+    got = {}
+    for name, factor in (("probe", 0), ("merge", 1 << 60)):
+        monkeypatch.setattr(MergeExecutor, "PROBE_LOOKUP_FACTOR", factor)
+        eng = TPUEngine(g, None)
+        got[name] = eng.execute_batch_index(mk(), B).tolist()
+    assert got["probe"] == got["merge"], (seed, shape, got)
+
+    # ground truth: the independent nested-loop oracle over raw triples
+    def raw(p):
+        if p.predicate == TYPE_ID and int(p.direction) == IN:
+            return (p.object, TYPE_ID, p.subject)
+        if int(p.direction) == OUT:
+            return (p.subject, p.predicate, p.object)
+        return (p.object, p.predicate, p.subject)
+
+    idx = TripleIndex(triples)
+    want = len(eval_bgp(idx, [raw(p) for p in pats],
+                        [-(i + 1) for i in range(nv)]))
+    assert got["probe"] == [want] * B, (seed, shape, want, got["probe"])
