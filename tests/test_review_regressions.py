@@ -392,8 +392,10 @@ def test_merge_chain_pins_match_staged_keys():
         in pins
     assert ("segf", 140001, int(OUT), ((int(TYPE_ID), int(OUT), 300002),)) \
         in pins
+    # k2k pins both forms too (probe-member arm)
     assert ("mrg", 140002, int(OUT)) in pins
+    assert (140002, int(OUT)) in pins
     assert ("rev", 140003, int(OUT), 200123) in pins
     # folded steps must NOT appear as separate pins
     assert not any(k[0] == "rev" and k[-1] in (300001, 300002) for k in pins)
-    assert len(pins) == 6
+    assert len(pins) == 7

@@ -350,21 +350,23 @@ def test_engine_pool_failure_detection_and_respawn():
 
 def test_emulator_inflight_window(proxy, monkeypatch):
     """After a class's first device batch learns capacities, subsequent
-    draws ride run_batch_const_many: W=parallel batches dispatch
-    back-to-back and sync once (the device path's honoring of -p)."""
+    draws ride the CROSS-CLASS flight (run_batch_const_mixed): W=parallel
+    batches dispatch back-to-back and sync once (the device path's
+    honoring of -p). With one class in the mix, every drawn job is that
+    class."""
     monkeypatch.setattr(Global, "enable_tpu", True)
     mix = load_mix_config(f"{EMU}/mix_config", proxy.str_server)
     mix.templates = mix.templates[:1]  # one class => deterministic warm-up
     mix.heavies = []
     mix.weights = mix.weights[:1]
     calls = []
-    orig = proxy.tpu.merge.run_batch_const_many
+    orig = proxy.tpu.merge.run_batch_const_mixed
 
-    def spy(q, batches):
-        calls.append(len(batches))
-        return orig(q, batches)
+    def spy(jobs):
+        calls.append(len(jobs))
+        return orig(jobs)
 
-    monkeypatch.setattr(proxy.tpu.merge, "run_batch_const_many", spy)
+    monkeypatch.setattr(proxy.tpu.merge, "run_batch_const_mixed", spy)
     out = Emulator(proxy).run(mix, duration_s=8.0, warmup_s=0.5, batch=64,
                               parallel=4)
     assert out["thpt_qps"] > 0
