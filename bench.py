@@ -460,7 +460,10 @@ def watdiv_main(device_ok: bool) -> None:
                 try:
                     counts = eng.execute_batch(q, consts)
                 except Exception as e:
-                    if "exceeds capacity" in str(e) and bw > 1:
+                    s = str(e)
+                    if bw > 1 and ("exceeds capacity" in s  # merge path
+                                   or "table_capacity_max" in s  # v1 chain
+                                   or "RESOURCE_EXHAUSTED" in s):  # HBM OOM
                         bw = max(bw // 2, 1)
                         best, q_best, trial = None, None, 0
                         continue
@@ -487,7 +490,7 @@ def watdiv_main(device_ok: bool) -> None:
     backend = "TPU single chip" if device_ok else "cpu-fallback"
     print(json.dumps({
         "metric": f"WatDiv-{scale} S/F templates geomean latency, {backend},"
-                  f" blind, batch={BATCH}"
+                  f" blind, batch={_batch_label(details)}"
                   + (f"; FAILED: {','.join(failed)}" if failed else ""),
         "value": round(_geomean(lat_us), 1),
         "unit": "us",
@@ -495,6 +498,16 @@ def watdiv_main(device_ok: bool) -> None:
         "backend": "tpu" if device_ok else "cpu",
         "detail": details,
     }))
+
+
+def _batch_label(details: dict) -> str:
+    """Honest batch label: the single batch when uniform, the range when
+    per-template capacity backoff diverged them."""
+    bs = sorted({v["batch"] for v in details.values()
+                 if isinstance(v, dict) and "batch" in v})
+    if not bs:
+        return str(BATCH)
+    return str(bs[0]) if len(bs) == 1 else f"{bs[0]}-{bs[-1]} (backoff)"
 
 
 def dbpedia_main(device_ok: bool) -> None:
