@@ -28,3 +28,22 @@ def eight_cpu_devices():
     devs = jax.devices()
     assert len(devs) >= 8, f"expected >=8 virtual devices, got {devs}"
     return devs[:8]
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _bound_compiled_program_accumulation():
+    """Free compiled executables between test MODULES: a full one-shot
+    `pytest tests/` accumulates thousands of distinct XLA:CPU programs in
+    one process, and on single-core hosts the compiler segfaults once
+    enough executables are live (observed twice at ~76% of the suite,
+    crashing inside backend_compile_and_load while compiling yet another
+    kernel; the same tests pass when the process starts closer to them).
+    Clearing jit caches per module bounds the live-program count; modules
+    re-jit lazily at a small cost."""
+    yield
+    import gc
+
+    import jax
+
+    jax.clear_caches()
+    gc.collect()
