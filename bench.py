@@ -789,6 +789,71 @@ def _measure_one(qn: str, scale: int) -> dict:
     return out
 
 
+def micro_main(device_ok: bool) -> None:
+    """`bench.py --micro`: the kernel-cost microbenchmarks behind every
+    dispatch constant (ROADMAP.md "Measured on-chip facts"): sort /
+    variadic sort / gather / scatter-max / cumsum at heavy-table sizes,
+    plus the host<->device sync RTT. One JSON line, ns/elem per op — a
+    healthy session re-derives the sort-vs-gather economics (the
+    PROBE_LOOKUP_FACTOR = 16 basis) in one command instead of ad-hoc
+    probes."""
+    import jax
+    import jax.numpy as jnp
+
+    N = int(os.environ.get("WUKONG_MICRO_N", str(16 * 2**20)))
+    rng = np.random.default_rng(0)
+    vals = jnp.asarray(rng.integers(0, 2**31 - 2, N, dtype=np.int32))
+    idx = jnp.asarray(rng.integers(0, N, N, dtype=np.int32))
+    payload = jnp.asarray(rng.integers(0, 2**31 - 2, N, dtype=np.int32))
+
+    def timed(fn, *args, reps=3):
+        fn_j = jax.jit(fn)
+        jax.block_until_ready(fn_j(*args))  # compile + warm
+        best = None
+        for _ in range(reps):
+            t = time.perf_counter()
+            jax.block_until_ready(fn_j(*args))
+            dt = time.perf_counter() - t
+            best = dt if best is None else min(best, dt)
+        return best * 1e9 / N  # ns per element
+
+    detail = {}
+    detail["sort_1op"] = round(timed(jnp.sort, vals), 3)
+    detail["sort_kv2"] = round(timed(
+        lambda k, p: jax.lax.sort((k, p), num_keys=1), vals, payload), 3)
+    detail["sort_kv3"] = round(timed(
+        lambda k, p, q: jax.lax.sort((k, p, q), num_keys=2),
+        vals, payload, idx), 3)
+    detail["gather_random"] = round(timed(lambda v, i: v[i], vals, idx), 3)
+    detail["cumsum"] = round(timed(jnp.cumsum, vals), 3)
+    detail["cummax"] = round(timed(jax.lax.cummax, vals), 3)
+    detail["scatter_max"] = round(timed(
+        lambda v, i: jnp.zeros(N, jnp.int32).at[i].max(v), vals, idx), 3)
+    # host<->device sync RTT (flat cost every chain pays exactly once)
+    t_best = None
+    for _ in range(5):
+        t = time.perf_counter()
+        jax.device_get(vals[:1])
+        dt = time.perf_counter() - t
+        t_best = dt if t_best is None else min(t_best, dt)
+    detail["sync_rtt_ms"] = round(t_best * 1e3, 2)
+    # the dispatch economics this justifies
+    detail["gather_over_sort"] = round(
+        detail["gather_random"] / max(detail["sort_1op"], 1e-9), 2)
+    backend = "tpu" if device_ok else "cpu"
+    print(json.dumps({
+        "metric": f"kernel-cost microbenchmarks at N={N:,} int32 "
+                  f"({backend} backend): ns/elem per op + sync RTT "
+                  "(the sort-vs-gather economics behind the lookup "
+                  "dispatch factors)",
+        "value": detail["sort_1op"],
+        "unit": "ns/elem",
+        "vs_baseline": None,
+        "backend": backend,
+        "detail": detail,
+    }))
+
+
 def _at_scale_verify_main() -> None:
     """`bench.py --at-scale-verify <qn,...>`: oracle-verification subprocess
     for the at-scale run. Loads the world ONCE, then per query:
@@ -1175,6 +1240,9 @@ def main():
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    if "--micro" in sys.argv:
+        micro_main(device_ok)
+        return
     if "--emu" in sys.argv:
         emu_main(device_ok)
         return

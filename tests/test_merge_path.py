@@ -237,13 +237,20 @@ def test_run_batch_index_many_matches_single(engines, world):
         assert np.array_equal(np.asarray(counts), np.asarray(single))
 
 
-def test_bytes_model_roofline(engines, world):
+def test_bytes_model_roofline(engines, world, monkeypatch):
     """The host-side HBM-traffic model (bench roofline fields): after a run,
     bytes_model reports the staged segment sizes actually in the device
     cache plus a capacity-driven table-state term, and scales its table term
-    with B (capacity classes are per-batch)."""
+    with B (capacity classes are per-batch). The lookup dispatch is pinned
+    to the merge arm so the segment term's B-invariance assertion holds
+    (the backend-aware factor can legitimately flip arms between capacity
+    classes, changing what the model counts as streamed)."""
+    from wukong_tpu.engine.tpu_merge import MergeExecutor
+
+    monkeypatch.setattr(MergeExecutor, "PROBE_LOOKUP_FACTOR", 1 << 60)
     _, tpu = engines
     _, ss = world
+    tpu.merge._cap_memo.clear()  # memoized caps were learned on other arms
     q = _parse(ss, f"{BASIC}/lubm_q7")
     tpu.execute_batch_index(q, 2)
     bm = tpu.merge.bytes_model(q, 2, "rep")

@@ -455,20 +455,31 @@ class MergeExecutor:
     # sort 2.2-3.1 ns/elem, gather ~9.5 ns/elem — ROADMAP.md table).
     PROBE_LOOKUP_FACTOR = 16
 
+    def _lookup_factor(self) -> int:
+        """Backend-aware crossover: the sort-vs-gather economics INVERT
+        across backends (bench.py --micro — TPU: sort 2-3 ns/elem vs
+        gather 9.5; CPU: sort ~80 ns/elem vs gather ~2.5), so the probe
+        arm wins ~8x earlier on the CPU fallback. Forced settings
+        (factor 0 / huge in tests) scale through unchanged."""
+        f = self.PROBE_LOOKUP_FACTOR
+        if getattr(self.eng.dstore.device, "platform", "cpu") != "tpu":
+            f = f // 8
+        return f
+
     def _probe_lookup_wins(self, cap_in: int, pid: int, d: int) -> bool:
         """STATIC per capacity class (host metadata only — deciding must
         never stage a segment). Consumed by _dispatch (live capacity) and
         bytes_model (walked capacity); pins cover both outcomes, so a
         learning-phase flip can't leave the staged form unprotected."""
         return (self.eng.dstore.host_num_keys(pid, d)
-                >= cap_in * self.PROBE_LOOKUP_FACTOR)
+                >= cap_in * self._lookup_factor())
 
     def _probe_member_wins(self, cap_in: int, pid: int, d: int) -> bool:
         """Membership twin of _probe_lookup_wins: merge_member_pairs sorts
         the per-EDGE pair arrays, so the dispatch scalar is the edge
         count."""
         return (self.eng.dstore.host_num_edges(pid, d)
-                >= cap_in * self.PROBE_LOOKUP_FACTOR)
+                >= cap_in * self._lookup_factor())
 
     def _walk_caps(self, pats, folds, index_mode: bool, B: int, mode: str):
         """THE shared chain walk with capacity evolution: yields
@@ -749,7 +760,7 @@ class MergeExecutor:
                         cur, vals, state.n, state.live_mask())
         else:
             rev, real = eng.dstore.const_list(pid, d, end)
-            if real >= state.cap * self.PROBE_LOOKUP_FACTOR:
+            if real >= state.cap * self._lookup_factor():
                 keep = K.member_list_binsearch(rev, jnp.int32(real), cur,
                                                state.n, state.live_mask())
             else:
@@ -875,7 +886,7 @@ class MergeExecutor:
                 # flip the modeled branch with cache state)
                 real = (int(ent[1]) if ent is not None else len(
                     eng.dstore._const_members(pid, d, end)))
-                if real >= cap * self.PROBE_LOOKUP_FACTOR:
+                if real >= cap * self._lookup_factor():
                     seg_b += W * cap * 32  # binary-search gathers
                 else:
                     seg_b += list_bytes(key, lambda: real)
