@@ -963,6 +963,58 @@ def _at_scale_verify_main() -> None:
         except Exception as e:
             out[qn] = {"ok": False, "error": repr(e)[:300]}
         print(f"# verify {qn}: {out[qn]}", file=sys.stderr, flush=True)
+
+    # the beyond-reference VERSATILE family at the same scale: ?x ?p ?y
+    # with x bound, device engine vs CPU oracle, full table multiset
+    # (the reference accelerator refuses the shape outright)
+    if os.environ.get("WUKONG_VERIFY_VERSATILE", "1") == "1":
+        import copy
+
+        t_v = time.time()
+        try:
+            vtext = (
+                "PREFIX ub: <http://swat.cse.lehigh.edu/onto/univ-bench.owl#>\n"
+                "SELECT ?X ?P ?Y WHERE { ?X ub:worksFor "
+                "<http://www.Department0.University0.edu> . ?X ?P ?Y . }")
+            qd = Parser(ss).parse(vtext)
+            planner.generate_plan(qd)
+            qc = copy.deepcopy(qd)  # identical plan on both engines
+            # separate time boxes: a slow device run must not eat the
+            # oracle's budget, and a device stall must not be blamed on
+            # the oracle
+            stage = "device"
+            signal.alarm(oracle_box)
+            try:
+                eng.execute(qd, from_proxy=False)
+                signal.alarm(0)
+                stage = "oracle"
+                signal.alarm(oracle_box)
+                cpu.execute(qc, from_proxy=False)
+            finally:
+                signal.alarm(0)
+            got = sorted(map(tuple, np.asarray(qd.result.table).tolist()))
+            want = sorted(map(tuple, np.asarray(qc.result.table).tolist()))
+            # witness that the DEVICE versatile chain actually ran: expand2
+            # stages the combined adjacency under a ("vpv", dir) key — if it
+            # is absent, both runs came from the host path and the compare
+            # would be vacuous
+            device_ran = any(k[0] == "vpv" for k in eng.dstore._cache)
+            out["versatile_xpy"] = {
+                "ok": (qd.result.status_code == 0
+                       and qc.result.status_code == 0 and got == want
+                       and device_ran),
+                "device_status": int(qd.result.status_code),
+                "oracle_status": int(qc.result.status_code),
+                "device_rows": len(got), "oracle_rows": len(want),
+                "device_versatile_staged": device_ran,
+                "verify_s": round(time.time() - t_v, 1)}
+        except _OracleTimeout:
+            out["versatile_xpy"] = {
+                "ok": None, "error": f"{stage} timeout ({oracle_box}s)"}
+        except Exception as e:
+            out["versatile_xpy"] = {"ok": False, "error": repr(e)[:300]}
+        print(f"# verify versatile_xpy: {out['versatile_xpy']}",
+              file=sys.stderr, flush=True)
     print(json.dumps(out))
 
 
