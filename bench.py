@@ -994,11 +994,13 @@ def _at_scale_verify_main() -> None:
                 signal.alarm(0)
             got = sorted(map(tuple, np.asarray(qd.result.table).tolist()))
             want = sorted(map(tuple, np.asarray(qc.result.table).tolist()))
-            # witness that the DEVICE versatile chain actually ran: expand2
-            # stages the combined adjacency under a ("vpv", dir) key — if it
-            # is absent, both runs came from the host path and the compare
-            # would be vacuous
-            device_ran = any(k[0] == "vpv" for k in eng.dstore._cache)
+            # witness that the DEVICE versatile chain actually ran: the
+            # combined-adjacency serve counter (eviction-proof — the 2560
+            # staging exceeds the cache budget and is dropped right after
+            # unpinning, so cache presence alone would false-negative).
+            # Without it, both runs came from the host path and the
+            # compare would be vacuous.
+            device_ran = eng.dstore.versatile_hits > 0
             out["versatile_xpy"] = {
                 "ok": (qd.result.status_code == 0
                        and qc.result.status_code == 0 and got == want

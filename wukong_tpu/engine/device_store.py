@@ -239,6 +239,10 @@ class DeviceStore:
         self._lru: list = []
         self._pinned: set = set()
         self.bytes_used = 0
+        self.versatile_hits = 0  # times a combined segment was served —
+        # an eviction-proof witness that the device versatile arm ran
+        # (the staging itself can exceed the cache budget and be evicted
+        # right after unpinning, so cache presence is not evidence)
 
     # ---- segment staging -------------------------------------------------
     def _check_version(self) -> None:
@@ -284,6 +288,7 @@ class DeviceStore:
         key = ("vpv", int(d))
         if key in self._cache:
             self._touch(key)
+            self.versatile_hits += 1
             return self._cache[key]
         import jax
         import jax.numpy as jnp
@@ -291,6 +296,7 @@ class DeviceStore:
         keys, offsets, w, p = combined_adjacency(self.g, d)
         if len(keys) == 0:
             return None
+        self.versatile_hits += 1
         seg = self._stage(keys, offsets, w)
         Ep = seg.edges.shape[0]
         p_pad = np.full(Ep, INT32_MAX, dtype=np.int32)
