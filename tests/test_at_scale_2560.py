@@ -21,6 +21,8 @@ import numpy as np
 import pytest
 from numpy.lib import format as npf
 
+from wukong_tpu.types import NORMAL_ID_START
+
 CACHE = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), ".cache")
 STORE = os.path.join(CACHE, "lubm2560_v2_p0.npz")
@@ -99,7 +101,7 @@ def test_planned_chains_fit_hbm(store_meta):
         pats = q.pattern_group.patterns
         if any(p.predicate < 0 for p in pats):
             continue  # host-path shape, no device chain to budget
-        index_mode = pats[0].subject < (1 << 17)
+        index_mode = pats[0].subject < NORMAL_ID_START
         folds = MergeExecutor._plan_folds(pats, index_mode=index_mode)
         pins = MergeExecutor._chain_pins(pats, folds, index_mode=index_mode)
         pin_bytes = 0

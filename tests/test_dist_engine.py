@@ -22,6 +22,20 @@ DIST_QUERIES = ["lubm_q1", "lubm_q2", "lubm_q3", "lubm_q4", "lubm_q5",
                 "lubm_q6", "lubm_q7", "lubm_q12"]
 
 
+@pytest.fixture(autouse=True)
+def _pin_collective_route():
+    """At LUBM-1 every const-start chain is light, so the default in-place
+    routing would answer most of this module without touching the
+    collective machinery it validates. Pin the sharded route; the
+    test_inplace_* cases flip the flag back on explicitly."""
+    from wukong_tpu.config import Global
+
+    old = Global.enable_dist_inplace
+    Global.enable_dist_inplace = False
+    yield
+    Global.enable_dist_inplace = old
+
+
 @pytest.fixture(scope="module")
 def world(eight_cpu_devices):
     triples, _ = generate_lubm(1, seed=42)
@@ -654,3 +668,153 @@ def test_learned_caps_tighten_steady_state(world):
     dist.force_cap_override = {("cap", 1): 2}
     rows3, st3 = run()
     assert rows3 == rows1 and st3["retries"] >= 1
+
+
+# ----------------------------------------------------------------------
+# round-5 in-place owner-routed fast path (reference need_fork_join,
+# sparql.hpp:802-814; proxy owner routing, proxy.hpp:201-219)
+# ----------------------------------------------------------------------
+def _rows_over_shared_vars(q):
+    cols = [q.result.v2c_map[v] for v in sorted(q.result.v2c_map)]
+    return sorted(map(tuple, np.asarray(q.result.table)[:, cols].tolist()))
+
+
+def test_inplace_routes_agree_with_collective(world):
+    """Light const-start chains route in place (zero collectives) and must
+    produce identical rows to the sharded chain — the both-routes
+    verification the round-4 verdict asked the suite to carry."""
+    from wukong_tpu.config import Global
+    from wukong_tpu.types import NORMAL_ID_START
+
+    ss, cpu, dist = world
+    for qn in ("lubm_q4", "lubm_q5", "lubm_q6"):
+        text = open(f"{BASIC}/{qn}").read()
+        q1 = Parser(ss).parse(text)
+        heuristic_plan(q1)
+        first = q1.pattern_group.patterns[0]
+        Global.enable_dist_inplace = True
+        try:
+            dist.execute(q1)
+        finally:
+            Global.enable_dist_inplace = False
+        st = dist.last_chain_stats or {}
+        assert q1.result.status_code == 0, qn
+        if first.subject >= NORMAL_ID_START and first.predicate > 0:
+            assert st.get("mode") == "inplace", (qn, st)
+        q2 = Parser(ss).parse(text)
+        heuristic_plan(q2)
+        dist.execute(q2)  # collective (autouse fixture pinned the flag off)
+        assert q2.result.status_code == 0, qn
+        assert _rows_over_shared_vars(q1) == _rows_over_shared_vars(q2), qn
+
+
+def test_inplace_overflow_falls_back_to_collective(world):
+    """A chain whose live table outgrows dist_inplace_rows mid-walk aborts
+    the in-place route and re-runs through the collective path with
+    identical results (the fork-join analogue of need_fork_join)."""
+    from wukong_tpu.config import Global
+
+    ss, cpu, dist = world
+    text = """PREFIX ub: <http://swat.cse.lehigh.edu/onto/univ-bench.owl#>
+    SELECT ?X ?Y WHERE {
+        ?X ub:subOrganizationOf <http://www.University0.edu> .
+        ?Y ub:memberOf ?X .
+    }"""
+    q0 = Parser(ss).parse(text)
+    heuristic_plan(q0)
+    first = q0.pattern_group.patterns[0]
+    fan = len(cpu.g.get_triples(first.subject, first.predicate,
+                                first.direction))
+    assert fan > 0
+    qc = Parser(ss).parse(text)
+    heuristic_plan(qc)
+    cpu.execute(qc, from_proxy=False)
+    assert qc.result.nrows > fan  # the expansion that must trip the abort
+
+    Global.enable_dist_inplace = True
+    old_thr = Global.dist_inplace_rows
+    Global.dist_inplace_rows = fan  # entry passes; first expansion overflows
+    try:
+        qd = Parser(ss).parse(text)
+        heuristic_plan(qd)
+        dist.execute(qd, from_proxy=False)
+    finally:
+        Global.dist_inplace_rows = old_thr
+        Global.enable_dist_inplace = False
+    assert qd.result.status_code == 0
+    st = dist.last_chain_stats or {}
+    assert st.get("mode") != "inplace", st  # retreated to the sharded chain
+    assert qd.result.nrows == qc.result.nrows
+
+
+def test_inplace_seeded_union_child(world):
+    """Seeded (UNION) children with small parent tables also ride the
+    in-place route; merged rows must match the collective run."""
+    from wukong_tpu.config import Global
+
+    ss, cpu, dist = world
+    text = """PREFIX ub: <http://swat.cse.lehigh.edu/onto/univ-bench.owl#>
+    SELECT ?X ?Y WHERE {
+        ?X ub:worksFor <http://www.Department0.University0.edu> .
+        { ?X ub:teacherOf ?Y . } UNION { ?Y ub:advisor ?X . }
+    }"""
+    Global.enable_dist_inplace = True
+    try:
+        q1 = Parser(ss).parse(text)
+        heuristic_plan(q1)
+        dist.execute(q1)
+    finally:
+        Global.enable_dist_inplace = False
+    assert q1.result.status_code == 0
+    q2 = Parser(ss).parse(text)
+    heuristic_plan(q2)
+    dist.execute(q2)
+    assert q2.result.status_code == 0
+    assert q1.result.nrows > 0
+    assert _rows_over_shared_vars(q1) == _rows_over_shared_vars(q2)
+
+
+def test_inplace_attr_tail_and_blind(world):
+    """In-place prefix + owner-routed attr tail + blind count parity."""
+    from wukong_tpu.config import Global
+
+    ss, cpu, dist = world
+    text = open(f"{BASIC}/lubm_q4").read()
+    Global.enable_dist_inplace = True
+    try:
+        qb = Parser(ss).parse(text)
+        heuristic_plan(qb)
+        qb.result.blind = True
+        dist.execute(qb)
+    finally:
+        Global.enable_dist_inplace = False
+    qc = Parser(ss).parse(text)
+    heuristic_plan(qc)
+    cpu.execute(qc, from_proxy=False)
+    assert qb.result.status_code == 0
+    assert qb.result.nrows == qc.result.nrows
+    assert qb.result.table.shape[0] == 0  # blind: the table never ships
+
+
+def test_dist_cap_memo_roundtrip(world, tmp_path):
+    """Learned capacity classes persist across engines/processes: a fresh
+    engine loading the memo starts at the exact classes (round-5 cold-start
+    fix); in-process learning wins over a stale memo (setdefault)."""
+    ss, cpu, dist = world
+    text = open(f"{BASIC}/lubm_q7").read()
+    q = Parser(ss).parse(text)
+    heuristic_plan(q)
+    q.result.blind = True
+    dist.execute(q, from_proxy=False)
+    assert q.result.status_code == 0 and dist._learned_caps
+    path = str(tmp_path / "caps.json")
+    dist.save_cap_memo(path)
+
+    fresh = DistEngine(dist.sstore.stores, ss, dist.mesh)
+    fresh.load_cap_memo(path)
+    assert fresh._learned_caps == dist._learned_caps
+    # in-process learning is not clobbered by a later load
+    key = next(iter(fresh._learned_caps))
+    fresh._learned_caps[key] = {("cap", 0): 1024}
+    fresh.load_cap_memo(path)
+    assert fresh._learned_caps[key] == {("cap", 0): 1024}

@@ -165,12 +165,24 @@ def test_fuzz_random_bgps_all_engines(world, seed, eight_cpu_devices):
         want = sorted(eval_bgp(idx, raw, req))
         engines = [("cpu", cpu), ("tpu", tpu)]
         if raw[0][0] > 0:  # const-anchored: dist-plannable shape
+            # both distributed routes: the default (in-place owner-routed
+            # when light) and the pinned collective shard_map chain
             engines.append(("dist", dist))
+            engines.append(("dist-collective", dist))
         outs = {}
         for name, eng in engines:
             q = _mk_bgp_query(raw, req)
             assert planner.generate_plan(q)
-            eng.execute(q)
+            if name == "dist-collective":
+                from wukong_tpu.config import Global
+
+                Global.enable_dist_inplace = False
+                try:
+                    eng.execute(q)
+                finally:
+                    Global.enable_dist_inplace = True
+            else:
+                eng.execute(q)
             assert q.result.status_code == 0, (name, raw)
             cols = [q.result.var2col(v) for v in req]
             outs[name] = sorted(
@@ -222,9 +234,19 @@ def test_fuzz_versatile_shapes_all_engines(world, seed, eight_cpu_devices):
     for raw in shapes():
         req = sorted({v for pat in raw for v in pat if v < 0}, reverse=True)
         want = sorted(eval_bgp(idx, raw, req))
-        for name, eng in (("cpu", cpu), ("tpu", tpu), ("dist", dist)):
+        for name, eng in (("cpu", cpu), ("tpu", tpu), ("dist", dist),
+                          ("dist-collective", dist)):
             q = _mk_bgp_query(raw, req)
-            eng.execute(q, from_proxy=False)
+            if name == "dist-collective":
+                from wukong_tpu.config import Global
+
+                Global.enable_dist_inplace = False
+                try:
+                    eng.execute(q, from_proxy=False)
+                finally:
+                    Global.enable_dist_inplace = True
+            else:
+                eng.execute(q, from_proxy=False)
             assert q.result.status_code == 0, (name, raw)
             cols = [q.result.var2col(v) for v in req]
             got = sorted(

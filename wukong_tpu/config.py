@@ -44,6 +44,15 @@ class GlobalConfig:
     silent: bool = True  # blind mode: don't ship result tables to the proxy
     mt_threshold: int = 8  # max fan-out slices for heavy index-origin queries
     rdma_threshold: int = 300  # rows >= threshold -> fork-join (dist shuffle)
+    # owner-routed in-place execution for small-table distributed chains
+    # (reference need_fork_join, sparql.hpp:802-814 + proxy owner routing,
+    # proxy.hpp:201-219): a chain whose live table stays under this many
+    # rows runs host-side with per-row owner-routed reads and ZERO
+    # collectives; growing past it aborts back to the collective path.
+    # Scaled above rdma_threshold because the single-driver "one-sided
+    # read" is a host array access, far cheaper than an RDMA round trip.
+    enable_dist_inplace: bool = True
+    dist_inplace_rows: int = 16384
     stealing_pattern: int = 0  # 0: pair, 1: ring (host engine work stealing)
     enable_budget: bool = True
     gpu_enable_pipeline: bool = True  # prefetch next pattern's segments to HBM

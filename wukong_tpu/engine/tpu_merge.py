@@ -782,6 +782,32 @@ class MergeExecutor:
             state.live = keep  # defer: fold into the next expand's degrees
 
     # ------------------------------------------------------------------
+    def _probe_rounds(self, pid: int, d: int) -> int:
+        """The probe kernels' ACTUAL static probe bound for this segment —
+        from the staged device segment when present (it is, for any chain
+        just measured: _dispatch staged it), a conservative 2 otherwise.
+        bytes_model uses this instead of a fixed worst-case constant so the
+        model's lower-bound guarantee holds (round-4 advisor)."""
+        seg = self.eng.dstore._cache.get((int(pid), int(d)))
+        return int(seg.max_probe) if seg is not None else 2
+
+    def _member_depth(self, pid: int, d: int) -> int:
+        """The probe-member kernel's static binary-search depth
+        (member_mask_known's `depth` arg = seg.max_deg_log2); host-CSR
+        max-degree bit_length as fallback when the segment is unstaged."""
+        dstore = self.eng.dstore
+        seg = dstore._cache.get((int(pid), int(d)))
+        if seg is not None:
+            return int(seg.max_deg_log2)
+        csr = dstore._host_csr(pid, d)
+        if csr is None:
+            return 1
+        _keys, offs, _edges = csr
+        import numpy as _np
+
+        md = int(_np.max(offs[1:] - offs[:-1])) if len(offs) > 1 else 1
+        return max(md.bit_length(), 1)
+
     def bytes_model(self, q, B: int, mode: str) -> dict | None:
         """Host-side HBM-traffic model of the planned batch chain — the
         roofline half of the bench artifact. Walks `classify` exactly as the
@@ -851,10 +877,11 @@ class MergeExecutor:
             pid, d, end = int(pat.predicate), int(pat.direction), pat.object
             if kind == "expand":
                 if self._probe_lookup_wins(cap, pid, d):
-                    # bucket probe: ~2 bucket rows (3 arrays) per frontier
-                    # row + one gather per emitted edge — the whole point
-                    # of the probe path is NOT streaming the segment
-                    seg_b += W * (6 * cap + cap_out)
+                    # bucket probe: max_probe bucket rows (3 arrays) per
+                    # frontier row + one gather per emitted edge — the whole
+                    # point of the probe path is NOT streaming the segment
+                    seg_b += W * (3 * self._probe_rounds(pid, d) * cap
+                                  + cap_out)
                 else:
                     # merge_expand / stream_expand read skey+sstart+sdeg+
                     # edges (ekey stays untouched on the expand path)
@@ -869,9 +896,14 @@ class MergeExecutor:
                 continue
             if kind == "k2k":
                 if self._probe_member_wins(cap, pid, d):
-                    # bucket probe + per-row binary search: ~2 bucket rows
-                    # (3 arrays) + ~depth edge gathers per frontier row
-                    seg_b += W * cap * (6 + 32)
+                    # bucket probe + per-row binary search: max_probe bucket
+                    # rows (3 arrays) + depth edge gathers per frontier row —
+                    # the ACTUAL static depths the kernel compiles with
+                    # (member_mask_known's max_probe/depth args), not
+                    # worst-case constants, so the model stays a lower bound
+                    # (round-4 advisor)
+                    seg_b += W * cap * (3 * self._probe_rounds(pid, d)
+                                        + self._member_depth(pid, d))
                 else:
                     # merge_member_pairs reads only the (ekey, edges) pair
                     # arrays
@@ -887,7 +919,10 @@ class MergeExecutor:
                 real = (int(ent[1]) if ent is not None else len(
                     eng.dstore._const_members(pid, d, end)))
                 if real >= cap * self._lookup_factor():
-                    seg_b += W * cap * 32  # binary-search gathers
+                    # binary-search gathers at the kernel's actual depth:
+                    # log2 of the padded list length it searches over
+                    pad = int(ent[0].size) if ent is not None else real
+                    seg_b += W * cap * max(int(pad).bit_length(), 1)
                 else:
                     seg_b += list_bytes(key, lambda: real)
                 tab_b += W * cap + cap  # one column read + bool mask
