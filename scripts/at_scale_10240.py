@@ -32,7 +32,7 @@ import numpy as np
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-SCALE = 10240
+SCALE = int(os.environ.get("WUKONG_10240_SCALE", "10240"))  # override = smoke
 BASIC = "/root/reference/scripts/sparql_query/lubm/basic"
 BATCH = 1024
 
@@ -192,7 +192,7 @@ def main() -> None:
            and d["oracle"].get("ok") is False]
     os.chdir(REPO)
     obj = {
-        "metric": f"LUBM-10240 at-scale: {','.join(details)} on the CPU "
+        "metric": f"LUBM-{SCALE} at-scale: {','.join(details)} on the CPU "
                   f"backend (single 1-core host, in-RAM build, no disk "
                   f"cache), oracle-sampled"
                   + (f"; FAILED: {','.join(failed)}" if failed else "")

@@ -396,13 +396,12 @@ class DistEngine:
             plan = self._build_plan(q, cap_override, n_steps, seed)
             fn, args = self._get_fn(plan, seed, seed_cache)
             out = fn(*args)
-            import jax
 
             if q.result.blind:
-                ns, totals = jax.device_get((out["n"], out["totals"]))
+                ns, totals = _gather_host((out["n"], out["totals"]))
                 tables = None
             else:
-                tables, ns, totals = jax.device_get(
+                tables, ns, totals = _gather_host(
                     (out["table"], out["n"], out["totals"]))
             totals = np.asarray(totals)  # [D, 2 * nsteps]
             S = len(plan.steps)
@@ -1007,6 +1006,22 @@ class DistEngine:
                            in_specs=tuple(arg_specs), out_specs=out_specs,
                            check_vma=False)
         return jax.jit(mapped)
+
+
+def _gather_host(tree):
+    """Bring chain outputs to host. Single-process: plain device_get.
+    Multi-process (jax.distributed, the reference's mpiexec contract,
+    wukong.cpp:102-104): outputs are sharded across processes and
+    device_get would raise on non-addressable shards — every process
+    allgathers instead, so all controllers see identical totals/tables
+    and take identical retry/assembly decisions (SPMD discipline)."""
+    import jax
+
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        return multihost_utils.process_allgather(tree, tiled=True)
+    return jax.device_get(tree)
 
 
 def _is_index_pattern(pat) -> bool:
