@@ -582,11 +582,16 @@ def _batch_label(details: dict) -> str:
 
 
 def dbpedia_main(device_ok: bool) -> None:
-    """`bench.py --dbpedia`: DBpedia-shaped mixed L/C/F workload with the
-    type-centric planner on (BASELINE.json configs[4]). Queries are built in
-    id space from the synthesizer's metadata, mirroring the dbpsb shapes
-    (type + property stars, hub anchors, type-filtered chains); vs_baseline
-    is null (no published reference number for this hardware)."""
+    """`bench.py --dbpedia`: DBpedia-shaped workload with the type-centric
+    planner on (BASELINE.json configs[4]). Queries are built in id space
+    from the synthesizer's metadata and data, covering EVERY reference
+    dbpsb shape (scripts/sparql_query/dbpsb/dbpsb_q1-q5: type+property
+    star, literal-anchored lookup, reverse join to a const anchor, 4-wide
+    property star, DISTINCT star) plus hub-anchor and deep-chain variants
+    (round-4 verdict Weak #6 / next #7 — >=8 templates). After the latency
+    section a closed-loop mixed window (concurrency 1, round-robin) gives
+    a dbpsb-emu q/s figure. vs_baseline is null (no published reference
+    number for this hardware)."""
     from wukong_tpu.engine.tpu import TPUEngine
     from wukong_tpu.loader.generic_rdf import generate_generic
     from wukong_tpu.planner.optimizer import Planner
@@ -620,20 +625,73 @@ def dbpedia_main(device_ok: bool) -> None:
         q.result.blind = True
         return q
 
+    # data-driven anchors so the const-anchored shapes are non-empty: a
+    # typed subject with an outgoing normal edge (dbpsb_q2's labeled
+    # person), and a 2-hop reverse pair b --pB--> a --pA--> c (dbpsb_q3's
+    # developer/foundationPlace join)
+    norm = triples[(triples[:, 1] != TYPE_ID)]
+    typed_s = triples[triples[:, 1] == TYPE_ID]
+    type_of = dict(zip(typed_s[::-1, 0].tolist(), typed_s[::-1, 2].tolist()))
+    rs = rp = ro = t_rs = None
+    p0_subjects = set(norm[norm[:, 1] == pids[0]][:, 0].tolist())
+    for s, p, o in norm[:5000].tolist():
+        # the witness must satisfy ALL THREE Q2 patterns (typed, has the
+        # rp->ro edge, AND a pids[0] out-edge) or the benchmark could
+        # silently measure a planner-proved-empty shortcircuit
+        if s in type_of and s in p0_subjects:
+            rs, rp, ro, t_rs = s, p, o, type_of[s]
+            break
+    rev = None  # (a, pA, c, b, pB, t_b)
+    obj_first: dict = {}
+    for i, o in enumerate(norm[:50000, 2].tolist()):
+        obj_first.setdefault(int(o), i)
+    for a, pA, c_ in norm[:20000].tolist():
+        j = obj_first.get(int(a))
+        if j is not None and int(norm[j, 0]) in type_of:
+            b, pB = int(norm[j, 0]), int(norm[j, 1])
+            rev = (int(a), int(pA), int(c_), b, pB, type_of[b])
+            break
+
     cases = {
-        # L: type + property star (dbpsb_q1 shape)
-        "L1": mk([(-1, TYPE_ID, OUT, types[0]), (-1, pids[0], OUT, -2)], 2),
+        # dbpsb_q1: type + property star
+        "Q1_star": mk([(-1, TYPE_ID, OUT, types[0]),
+                       (-1, pids[0], OUT, -2)], 2),
+        # dbpsb_q4: type + 4-wide property star
+        "Q4_star4": mk([(-1, TYPE_ID, OUT, types[2]),
+                        (-1, pids[0], OUT, -2), (-1, pids[1], OUT, -3),
+                        (-1, pids[2], OUT, -4), (-1, pids[3], OUT, -5)], 5),
+        # dbpsb_q5: DISTINCT type + 2-property star
+        "Q5_distinct": mk([(-1, TYPE_ID, OUT, types[3]),
+                           (-1, pids[1], OUT, -2),
+                           (-1, pids[2], OUT, -3)], 3),
         # C: type-filtered 2-hop chain
         "C1": mk([(-1, TYPE_ID, OUT, types[1]), (-1, pids[1], OUT, -2),
                   (-2, pids[2], OUT, -3)], 3),
         # F: hub anchor + expansion (skew stress)
         "F1": mk([(-1, pids[0], OUT, hub), (-1, pids[3], OUT, -2)], 2),
+        # F2: hub anchor + 2-hop chain off it
+        "F2": mk([(-1, pids[0], OUT, hub), (-1, pids[3], OUT, -2),
+                  (-2, pids[4], OUT, -3)], 3),
     }
+    cases["Q5_distinct"].distinct = True
+    # DISTINCT must actually dedup: measured non-blind through the final
+    # phase (blind mode would drop the table before projection)
+    cases["Q5_distinct"].result.blind = False
+    if rs is not None:
+        # dbpsb_q2: const-anchored lookup + type check + property
+        cases["Q2_anchor"] = mk([(-1, rp, OUT, ro),
+                                 (-1, TYPE_ID, OUT, t_rs),
+                                 (-1, pids[0], OUT, -2)], 2)
+    if rev is not None:
+        a, pA, c_, b, pB, t_b = rev
+        # dbpsb_q3: ?v2 pA CONST ; ?v4 pB ?v2 ; ?v4 type T
+        cases["Q3_reverse"] = mk([(-1, pA, OUT, c_), (-2, pB, OUT, -1),
+                                  (-2, TYPE_ID, OUT, t_b)], 2)
     lat_us, details, failed = [], {}, []
+    import copy
+
     for name, q0 in cases.items():
         try:
-            import copy
-
             best = None
             nrows = -1
             for _trial in range(3):
@@ -641,7 +699,8 @@ def dbpedia_main(device_ok: bool) -> None:
                 if not planner.generate_plan(q):
                     raise RuntimeError("planner failed to produce a plan")
                 t = time.perf_counter()
-                eng.execute(q, from_proxy=False)
+                # from_proxy so the final phase (DISTINCT dedup) executes
+                eng.execute(q, from_proxy=True)
                 dt = (time.perf_counter() - t) * 1e6
                 if q.result.status_code != 0:
                     raise RuntimeError(f"status {q.result.status_code!r}")
@@ -656,6 +715,41 @@ def dbpedia_main(device_ok: bool) -> None:
             print(f"# {name}: FAILED ({e})", file=sys.stderr)
     if not lat_us:
         raise SystemExit("all dbpedia cases failed")
+
+    # dbpsb-emu: CLOSED-loop mixed window at concurrency 1 (back-to-back
+    # execution, round-robin over the templates — NOT comparable to an
+    # open-loop peak-throughput figure; the label in the artifact says so).
+    # The reference ships no dbpsb mix_config; weights documented uniform.
+    emu_s = float(os.environ.get("WUKONG_DBPSB_EMU_S", "8"))
+    ok_cases = {n: q for n, q in cases.items() if n not in failed}
+    if emu_s > 0 and ok_cases:
+        names = sorted(ok_cases)
+        planned = {}
+        for n in names:  # plan ONCE per template (the reference's emulator
+            # also plans per template, not per instance; planning dominated
+            # the draw), keep the pristine planned copy, precompile the
+            # blind chain before the window
+            q = copy.deepcopy(ok_cases[n])
+            if not planner.generate_plan(q):
+                continue
+            q.result.blind = True
+            planned[n] = copy.deepcopy(q)
+            eng.execute(q, from_proxy=False)
+        names = sorted(planned)
+        served = 0
+        t_end = time.perf_counter() + emu_s
+        while names and time.perf_counter() < t_end:
+            q = copy.deepcopy(planned[names[served % len(names)]])
+            eng.execute(q, from_proxy=False)
+            served += 1
+        qps = served / emu_s
+        details["dbpsb_emu"] = {"qps": round(qps, 1),
+                                "window_s": emu_s,
+                                "mix": "uniform round-robin",
+                                "loop": "closed, concurrency 1",
+                                "templates": len(planned)}
+        print(f"# dbpsb-emu: {qps:,.0f} q/s over {emu_s:.0f}s "
+              f"(closed loop, {len(planned)} templates)", file=sys.stderr)
     backend = "TPU single chip" if device_ok else "cpu-fallback"
     _emit_final({
         "metric": f"DBpedia-shaped ({len(triples):,} triples) mixed "
