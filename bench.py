@@ -633,6 +633,7 @@ def dbpedia_main(device_ok: bool) -> None:
     typed_s = triples[triples[:, 1] == TYPE_ID]
     type_of = dict(zip(typed_s[::-1, 0].tolist(), typed_s[::-1, 2].tolist()))
     rs = rp = ro = t_rs = None
+    omitted: list[str] = []
     p0_subjects = set(norm[norm[:, 1] == pids[0]][:, 0].tolist())
     for s, p, o in norm[:5000].tolist():
         # the witness must satisfy ALL THREE Q2 patterns (typed, has the
@@ -682,12 +683,24 @@ def dbpedia_main(device_ok: bool) -> None:
         cases["Q2_anchor"] = mk([(-1, rp, OUT, ro),
                                  (-1, TYPE_ID, OUT, t_rs),
                                  (-1, pids[0], OUT, -2)], 2)
+    else:
+        # a missing template must be VISIBLE, not a silently smaller suite
+        # (the round-4 verdict's done-bar is >=8 templates)
+        omitted.append("Q2_anchor")
+        print("# Q2_anchor: no witness row in the scan window — template "
+              "omitted", file=sys.stderr)
     if rev is not None:
         a, pA, c_, b, pB, t_b = rev
         # dbpsb_q3: ?v2 pA CONST ; ?v4 pB ?v2 ; ?v4 type T
         cases["Q3_reverse"] = mk([(-1, pA, OUT, c_), (-2, pB, OUT, -1),
                                   (-2, TYPE_ID, OUT, t_b)], 2)
-    lat_us, details, failed = [], {}, []
+    else:
+        omitted.append("Q3_reverse")
+        print("# Q3_reverse: no 2-hop typed witness in the scan window — "
+              "template omitted", file=sys.stderr)
+    lat_us, details, failed = [], {}, list(omitted)
+    for n in omitted:
+        details[n] = {"error": "no witness row found in the scan window"}
     import copy
 
     for name, q0 in cases.items():
@@ -764,6 +777,75 @@ def dbpedia_main(device_ok: bool) -> None:
         "dataset": DATASET_NOTES["dbpedia"],
         "detail": details,
     }, "BENCH_DBPEDIA_DETAIL.json")
+
+
+def yago_main(device_ok: bool) -> None:
+    """`bench.py --yago`: the reference yago suite (yago_q1-q4) executed
+    VERBATIM against the yago-shaped synthesized world (loader/yago.py —
+    the files' own constants resolve through YagoStrings). q3 is the
+    heavy: a 3-hop self-join over the power-law wiki-link relation.
+    vs_baseline null (the reference publishes no yago numbers for
+    comparable hardware)."""
+    from wukong_tpu.engine.tpu import TPUEngine
+    from wukong_tpu.loader.yago import YagoStrings, generate_yago
+    from wukong_tpu.planner.optimizer import Planner
+    from wukong_tpu.planner.stats import Stats
+    from wukong_tpu.sparql.parser import Parser
+    from wukong_tpu.store.gstore import build_partition
+
+    n_person = int(os.environ.get("WUKONG_YAGO_PERSONS", "0")) or \
+        (200_000 if device_ok else 30_000)
+    t0 = time.time()
+    triples, _meta = generate_yago(n_person, seed=0)
+    ss = YagoStrings(n_person, seed=0)
+    g = build_partition(triples, 0, 1)
+    stats = Stats.generate(triples)
+    planner = Planner(stats)
+    eng = TPUEngine(g, ss, stats=stats)
+    print(f"# yago-shaped world ({len(triples):,} triples, "
+          f"{n_person:,} persons) ready in {time.time() - t0:.0f}s",
+          file=sys.stderr)
+    lat_us, details, failed = [], {}, []
+    for k in range(1, 5):
+        qn = f"yago_q{k}"
+        try:
+            text = open(
+                f"/root/reference/scripts/sparql_query/yago/{qn}").read()
+            best, nrows = None, -1
+            for _trial in range(3):
+                q = Parser(ss).parse(text)
+                planner.generate_plan(q)
+                q.result.blind = True
+                t = time.perf_counter()
+                eng.execute(q, from_proxy=False)
+                dt = (time.perf_counter() - t) * 1e6
+                if q.result.status_code != 0:
+                    raise RuntimeError(f"status {q.result.status_code!r}")
+                nrows = q.result.nrows
+                best = dt if best is None else min(best, dt)
+            lat_us.append(best)
+            details[qn] = {"us": round(best, 1), "rows": nrows}
+            print(f"# {qn}: {best:,.0f} us (rows={nrows})", file=sys.stderr)
+        except Exception as e:
+            failed.append(qn)
+            details[qn] = {"error": str(e)[:200]}
+            print(f"# {qn}: FAILED ({e})", file=sys.stderr)
+    if not lat_us:
+        raise SystemExit("all yago queries failed")
+    backend = "TPU single chip" if device_ok else "cpu-fallback"
+    _emit_final({
+        "metric": f"yago-shaped ({len(triples):,} triples) reference "
+                  f"yago_q1-q4 geomean latency, {backend}, planner on"
+                  + (f"; FAILED: {','.join(failed)}" if failed else ""),
+        "value": round(_geomean(lat_us), 1),
+        "unit": "us",
+        "vs_baseline": None,
+        "backend": "tpu" if device_ok else "cpu",
+        "dataset": "synthetic yago-shaped data (loader/yago.py); the "
+                   "reference query files execute verbatim, data is not "
+                   "YAGO",
+        "detail": details,
+    }, "BENCH_YAGO_DETAIL.json")
 
 
 def _apply_kernel_toggles() -> None:
@@ -1488,6 +1570,9 @@ def main():
         return
     if "--dbpedia" in sys.argv:
         dbpedia_main(device_ok)
+        return
+    if "--yago" in sys.argv:
+        yago_main(device_ok)
         return
     scale = int(os.environ.get("WUKONG_BENCH_SCALE", "0"))
     if scale == 0:
