@@ -73,46 +73,118 @@ class Stats:
         ts, to = ts[order], to[order]
         uniq_v, starts = np.unique(ts, return_index=True)
         bounds = np.append(starts, len(ts))
-        vtypes: list[int] = []
         complex_ids: dict[frozenset, int] = {}
         next_complex = -1
         simple_counts: dict[int, int] = defaultdict(int)
-        for i, v in enumerate(uniq_v):
-            tset = frozenset(int(x) for x in to[bounds[i]:bounds[i + 1]])
-            if len(tset) == 1:
-                t = next(iter(tset))
-            else:
-                if tset not in complex_ids:
-                    complex_ids[tset] = next_complex
-                    next_complex -= 1
-                t = complex_ids[tset]
-            vtypes.append(t)
-            simple_counts[t] += 1
+        if len(uniq_v) == len(ts):
+            # every vertex single-typed (all LUBM-shaped data): the
+            # per-vertex frozenset loop is O(V) Python objects — at
+            # LUBM-10240 (220 M typed vertices) it OOM-killed the host;
+            # the vectorized equivalent is two array ops
+            typed_types = to[starts].astype(np.int64)
+            for t, c in zip(*np.unique(typed_types, return_counts=True)):
+                simple_counts[int(t)] += int(c)
+        else:
+            vtypes: list[int] = []
+            for i, v in enumerate(uniq_v):
+                tset = frozenset(int(x) for x in to[bounds[i]:bounds[i + 1]])
+                if len(tset) == 1:
+                    t = next(iter(tset))
+                else:
+                    if tset not in complex_ids:
+                        complex_ids[tset] = next_complex
+                        next_complex -= 1
+                    t = complex_ids[tset]
+                vtypes.append(t)
+                simple_counts[t] += 1
+            typed_types = np.asarray(vtypes, dtype=np.int64)
         # untyped vertices: complex type from their out-predicate set
         all_vs = np.unique(np.concatenate(
             [s, o[o >= NORMAL_ID_START]]))
         untyped = np.setdiff1d(all_vs, uniq_v)
+        untyped_types = np.empty(0, dtype=np.int64)
         if len(untyped):
             norm = ~is_type
             so_, po_ = s[norm], p[norm]
-            order2 = np.argsort(so_, kind="stable")
-            so_, po_ = so_[order2], po_[order2]
-            uv, ustarts = np.unique(so_, return_index=True)
-            ubounds = np.append(ustarts, len(so_))
-            pos = np.searchsorted(uv, untyped)
-            for v, j in zip(untyped, pos):
-                if j < len(uv) and uv[j] == v:
-                    pset = frozenset(int(x) for x in po_[ubounds[j]:ubounds[j + 1]])
-                else:
-                    pset = frozenset()
-                key = frozenset({("p", x) for x in pset})
+            # untyped subjects actually carrying out-edges (in LUBM-shaped
+            # data the untyped set is literal pools with NO out-edges, so
+            # this mask is empty and the whole branch is one shared class)
+            has_out = np.isin(untyped, so_)
+            if int(has_out.sum()) > 200_000:
+                # vectorized signature path: group by out-predicate SET
+                # via a commutative 64-bit mix over unique (s, p) pairs —
+                # the per-vertex frozenset loop at this cardinality is
+                # Python-object OOM territory
+                from wukong_tpu.utils.mathutil import hash_u64
+
+                keep = np.isin(so_, untyped)
+                # pack (s, p) into one int64: pred ids < 2^17 (NORMAL_ID_
+                # START) by construction, subject ids < 2^31 -> 48 bits
+                code = np.unique((so_[keep].astype(np.int64) << 17)
+                                 | po_[keep].astype(np.int64))
+                cs_, cp_ = code >> 17, code & ((1 << 17) - 1)
+                upids = np.unique(cp_)
+                hmap = np.asarray([hash_u64(int(x)) for x in upids],
+                                  dtype=np.uint64)
+                mixed = hmap[np.searchsorted(upids, cp_)]
+                uv2, ustarts2 = np.unique(cs_, return_index=True)
+                sig = np.add.reduceat(mixed, ustarts2)  # commutative mix
+                sgu, sinv = np.unique(sig, return_inverse=True)
+                sig_cids = np.arange(next_complex,
+                                     next_complex - len(sgu), -1,
+                                     dtype=np.int64)
+                for k in range(len(sgu)):
+                    # representative member set is informational only —
+                    # the loop path also strips ("p", x) tuples to {}
+                    complex_ids[frozenset({("sig", int(sgu[k]))})] = \
+                        int(sig_cids[k])
+                next_complex -= len(sgu)
+                cid_by_subject = sig_cids[sinv]  # aligned with uv2
+                pos2 = np.searchsorted(uv2, untyped)
+                pos2c = np.clip(pos2, 0, max(len(uv2) - 1, 0))
+                found2 = ((pos2 < len(uv2)) & (len(uv2) > 0)
+                          & (uv2[pos2c] == untyped))
+                key = frozenset()  # no-out-edge literals: one shared class
                 if key not in complex_ids:
                     complex_ids[key] = next_complex
                     next_complex -= 1
-                vtypes.append(complex_ids[key])
-                simple_counts[complex_ids[key]] += 1
+                untyped_types = np.where(
+                    found2, cid_by_subject[pos2c] if len(uv2) else 0,
+                    complex_ids[key]).astype(np.int64)
+                for t, c in zip(*np.unique(untyped_types,
+                                           return_counts=True)):
+                    simple_counts[int(t)] += int(c)
+            elif not has_out.any():
+                # all-literal untyped set: one shared empty-pset class
+                key = frozenset()
+                if key not in complex_ids:
+                    complex_ids[key] = next_complex
+                    next_complex -= 1
+                untyped_types = np.full(len(untyped), complex_ids[key],
+                                        dtype=np.int64)
+                simple_counts[complex_ids[key]] += len(untyped)
+            else:
+                order2 = np.argsort(so_, kind="stable")
+                so2, po2 = so_[order2], po_[order2]
+                uv, ustarts = np.unique(so2, return_index=True)
+                ubounds = np.append(ustarts, len(so2))
+                pos = np.searchsorted(uv, untyped)
+                uvt: list[int] = []
+                for v, j in zip(untyped, pos):
+                    if j < len(uv) and uv[j] == v:
+                        pset = frozenset(
+                            int(x) for x in po2[ubounds[j]:ubounds[j + 1]])
+                    else:
+                        pset = frozenset()
+                    key = frozenset({("p", x) for x in pset})
+                    if key not in complex_ids:
+                        complex_ids[key] = next_complex
+                        next_complex -= 1
+                    uvt.append(complex_ids[key])
+                    simple_counts[complex_ids[key]] += 1
+                untyped_types = np.asarray(uvt, dtype=np.int64)
         st.vtype_ids = np.concatenate([uniq_v, untyped]).astype(np.int64)
-        st.vtype = np.asarray(vtypes, dtype=np.int64)
+        st.vtype = np.concatenate([typed_types, untyped_types])
         order3 = np.argsort(st.vtype_ids)
         st.vtype_ids = st.vtype_ids[order3]
         st.vtype = st.vtype[order3]
