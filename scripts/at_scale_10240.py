@@ -72,10 +72,12 @@ def main() -> None:
     log("synthesizing LUBM-10240")
     triples, _lay = generate_lubm(SCALE, seed=0)
     log(f"{len(triples):,} triples")
-    # ids < 2^31 by the store contract (gstore.check_vid_range): narrowing
-    # to int32 halves every downstream sort/copy — the int64 run OOMed at
-    # 130 GB inside the (since vectorized) Stats.generate
-    triples = np.ascontiguousarray(triples.astype(np.int32))
+    # ids < 2^31 by the store contract (gstore.check_vid_range) — asserted
+    # HERE because Stats.generate consumes the narrowed array long before
+    # build_partition would catch a silent wrap. int32 halves every
+    # downstream sort/copy — the int64 run OOMed at 130 GB
+    assert int(triples.max()) < 2**31 - 1, "ids overflow int32"
+    triples = triples.astype(np.int32)
     log("narrowed to int32")
     stats = Stats.generate(triples)
     log("stats done")

@@ -108,16 +108,18 @@ class Stats:
             so_, po_ = s[norm], p[norm]
             # untyped subjects actually carrying out-edges (in LUBM-shaped
             # data the untyped set is literal pools with NO out-edges, so
-            # this mask is empty and the whole branch is one shared class)
-            has_out = np.isin(untyped, so_)
-            if int(has_out.sum()) > 200_000:
+            # this mask is empty and the whole branch is one shared class).
+            # ONE membership pass serves both the branch decision and the
+            # vectorized path below — each isin sorts the full edge list
+            keep = np.isin(so_, untyped)
+            n_out_subj = len(np.unique(so_[keep])) if keep.any() else 0
+            if n_out_subj > 200_000:
                 # vectorized signature path: group by out-predicate SET
                 # via a commutative 64-bit mix over unique (s, p) pairs —
                 # the per-vertex frozenset loop at this cardinality is
                 # Python-object OOM territory
                 from wukong_tpu.utils.mathutil import hash_u64
 
-                keep = np.isin(so_, untyped)
                 # pack (s, p) into one int64: pred ids < 2^17 (NORMAL_ID_
                 # START) by construction, subject ids < 2^31 -> 48 bits
                 code = np.unique((so_[keep].astype(np.int64) << 17)
@@ -144,17 +146,24 @@ class Stats:
                 pos2c = np.clip(pos2, 0, max(len(uv2) - 1, 0))
                 found2 = ((pos2 < len(uv2)) & (len(uv2) > 0)
                           & (uv2[pos2c] == untyped))
-                key = frozenset()  # no-out-edge literals: one shared class
-                if key not in complex_ids:
-                    complex_ids[key] = next_complex
-                    next_complex -= 1
+                empty_cid = 0
+                if not found2.all():
+                    # no-out-edge literals: one shared class, minted only
+                    # when such vertices exist (the loop path allocates on
+                    # first use; a phantom zero-member class would leak
+                    # into complex_members/statfiles)
+                    key = frozenset()
+                    if key not in complex_ids:
+                        complex_ids[key] = next_complex
+                        next_complex -= 1
+                    empty_cid = complex_ids[key]
                 untyped_types = np.where(
                     found2, cid_by_subject[pos2c] if len(uv2) else 0,
-                    complex_ids[key]).astype(np.int64)
+                    empty_cid).astype(np.int64)
                 for t, c in zip(*np.unique(untyped_types,
                                            return_counts=True)):
                     simple_counts[int(t)] += int(c)
-            elif not has_out.any():
+            elif n_out_subj == 0:
                 # all-literal untyped set: one shared empty-pset class
                 key = frozenset()
                 if key not in complex_ids:
