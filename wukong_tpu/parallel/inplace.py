@@ -105,9 +105,9 @@ class InplaceEngine(CPUEngine):
         start = np.zeros(len(cur), dtype=np.int64)
         deg = np.zeros(len(cur), dtype=np.int64)
         owners = owner_of_subject(cur, self._D)
-        for k in range(self._D):
+        for k in np.unique(owners):  # only shards that own frontier rows
             m = owners == k
-            if m.any() and segs[k] is not None:
+            if segs[k] is not None:
                 s, dg = segs[k].lookup_many(cur[m])
                 start[m] = s + bases[k]
                 deg[m] = dg
@@ -120,11 +120,11 @@ class InplaceEngine(CPUEngine):
         pos = np.asarray(start, dtype=np.int64) + np.asarray(local,
                                                             dtype=np.int64)
         out = np.empty(len(pos), dtype=np.int64)
-        for k in range(self._D):
-            m = (pos >= bases[k]) & (pos < bases[k + 1])
-            if m.any():
-                out[m] = np.asarray(segs[k].edges,
-                                    dtype=np.int64)[pos[m] - bases[k]]
+        ks = np.searchsorted(bases, pos, side="right") - 1
+        for k in np.unique(ks):  # only shards whose edge ranges are hit
+            m = ks == k
+            out[m] = np.asarray(segs[k].edges,
+                                dtype=np.int64)[pos[m] - bases[k]]
         return out
 
     def _contains_many(self, cur, pid: int, d: int, vals) -> np.ndarray:
@@ -135,9 +135,9 @@ class InplaceEngine(CPUEngine):
         vals = np.asarray(vals)
         ok = np.zeros(len(cur), dtype=bool)
         owners = owner_of_subject(cur, self._D)
-        for k in range(self._D):
+        for k in np.unique(owners):
             m = owners == k
-            if m.any() and segs[k] is not None:
+            if segs[k] is not None:
                 ok[m] = segs[k].contains_pair(cur[m], vals[m])
         return ok
 
