@@ -61,10 +61,13 @@ TILE = 256  # edges per grid step (TILE//128 sublane rows per cumsum)
 FORCE_INTERPRET = False
 
 # compaction backend: the one-hot plane either feeds two VPU masked
-# reductions (~6 passes over (2T, T)) or one MXU matmul on 16-bit halves
-# (~3 passes; exact — each output row selects at most one input, and halves
-# are < 2^16 so fp32 accumulation is lossless). stream_available() probes
-# the MXU variant first and flips to VPU if it fails to lower.
+# reductions (~6 passes over (2T, T)) or one MXU matmul on 16-bit halves at
+# precision=HIGHEST (multi-pass bf16, required for exactness on real
+# silicon — the default single-pass dot rounds inputs to 8 significant
+# bits; each output row selects at most one input and halves are < 2^16 so
+# fp32 accumulation is lossless). stream_available() probes the MXU variant
+# first and flips to VPU if it fails to lower or corrupts; relative cost is
+# a first-healthy-session measurement, not a constant.
 USE_MXU_COMPACT = True
 
 _stream_state = {"ok": None, "mhot": True}
@@ -196,13 +199,21 @@ def _psum_small(x2, incl: bool):
     prefix < 2^24, fp32-exact): one lane matmul + one sublane matmul."""
     R = x2.shape[0]
     xf = x2.astype(jnp.float32)
+    # precision=HIGHEST everywhere: the default single-pass bf16 MXU dot
+    # rounds INPUTS to 8 significant bits, silently corrupting the 16-bit
+    # halves (65533 -> 65536) and any row total > 2^8 — third real-silicon
+    # lesson, round 5; the fp32-exactness contract needs full-precision
+    # passes and these matrices are tiny
     within = jnp.dot(xf, _tri_ones(128, upper=True, strict=False),
-                     preferred_element_type=jnp.float32)
+                     preferred_element_type=jnp.float32,
+                     precision=jax.lax.Precision.HIGHEST)
     rtot = jnp.dot(xf, jnp.ones((128, 1), jnp.float32),
-                   preferred_element_type=jnp.float32)
+                   preferred_element_type=jnp.float32,
+                   precision=jax.lax.Precision.HIGHEST)
     # exclusive prefix of the row totals: roff[a] = sum_{b < a} rtot[b]
     roff = jnp.dot(_tri_ones(R, upper=False, strict=True), rtot,
-                   preferred_element_type=jnp.float32)
+                   preferred_element_type=jnp.float32,
+                   precision=jax.lax.Precision.HIGHEST)
     out = within + roff
     if not incl:
         out = out - xf
@@ -227,34 +238,41 @@ def _psum_i32(x2, incl: bool):
 def _dma_ring(stage_a, stage_b, out_a, out_b, sems, carry, cap_pad: int):
     """Double-buffered aligned-block flush helpers shared by both emit
     kernels. Capacity overflow skips the DMA but still counts blocks, so
-    waits are flag-guarded ([6+slot]), never inferred from block math."""
+    waits are flag-guarded ([6+slot]), never inferred from block math.
+
+    Blocks are staged LANE-MAJOR as (TILE//128, 128): tpu.memref_slice
+    requires lane-dim slices aligned to the (·,128) tiling, so a (T, 1)
+    column stage can never be DMA'd on real silicon (second real-silicon
+    lesson, round 5); outputs are (cap_pad//128, 128) HBM buffers whose
+    row-major flattening is the column order the callers expect."""
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     T = TILE
+    R2 = T // 128
 
     def wait_slot(slot):
         @pl.when(carry[6 + slot] == 1)
         def _():
             blk_idx = carry[4 + slot]
             pltpu.make_async_copy(
-                stage_a.at[slot], out_a.at[pl.ds(blk_idx * T, T), :],
+                stage_a.at[slot], out_a.at[pl.ds(blk_idx * R2, R2), :],
                 sems.at[slot, 0]).wait()
             pltpu.make_async_copy(
-                stage_b.at[slot], out_b.at[pl.ds(blk_idx * T, T), :],
+                stage_b.at[slot], out_b.at[pl.ds(blk_idx * R2, R2), :],
                 sems.at[slot, 1]).wait()
             carry[6 + slot] = 0
 
     def start_block(blk, slot, src_a, src_b):
         @pl.when((blk + 1) * T <= cap_pad)
         def _():
-            stage_a[slot] = src_a
-            stage_b[slot] = src_b
+            stage_a[slot] = src_a.reshape(R2, 128)
+            stage_b[slot] = src_b.reshape(R2, 128)
             pltpu.make_async_copy(
-                stage_a.at[slot], out_a.at[pl.ds(blk * T, T), :],
+                stage_a.at[slot], out_a.at[pl.ds(blk * R2, R2), :],
                 sems.at[slot, 0]).start()
             pltpu.make_async_copy(
-                stage_b.at[slot], out_b.at[pl.ds(blk * T, T), :],
+                stage_b.at[slot], out_b.at[pl.ds(blk * R2, R2), :],
                 sems.at[slot, 1]).start()
             carry[4 + slot] = blk
             carry[6 + slot] = 1
@@ -304,7 +322,9 @@ def _emit_kernel(edges_ref, dsel_ref, dpar_ref,
     # append to the accumulator at fill offset f via a one-hot plane:
     # M2[i, j] = sel[j] and (f + lrank[j] == i); rows i < f stay untouched
     f = carry[2]
-    sel_r = sel.reshape(1, T)
+    # reshape the int32 form: Mosaic's infer-vector-layout rejects i1 shape
+    # casts ((2,128)->(1,256) on vector<i1>) — real-silicon lesson, round 5
+    sel_r = selin.reshape(1, T) > 0
     lrank_r = lrank.reshape(1, T) + f
     es_r = es2.reshape(1, T)
     par_r = cpar.reshape(1, T)
@@ -319,8 +339,8 @@ def _emit_kernel(edges_ref, dsel_ref, dpar_ref,
             (es_r >> 16).reshape(T, 1), (es_r & 0xFFFF).reshape(T, 1),
             (par_r >> 16).reshape(T, 1), (par_r & 0xFFFF).reshape(T, 1),
         ], axis=1).astype(jnp.float32)  # (T, 4)
-        out4 = jnp.dot(mf, halves,
-                       preferred_element_type=jnp.float32).astype(jnp.int32)
+        out4 = jnp.dot(mf, halves, preferred_element_type=jnp.float32,
+                       precision=jax.lax.Precision.HIGHEST).astype(jnp.int32)
         acc_val[...] = acc_val[...] + (out4[:, 0:1] * jnp.int32(1 << 16)
                                        + out4[:, 1:2])
         acc_par[...] = acc_par[...] + (out4[:, 2:3] * jnp.int32(1 << 16)
@@ -375,23 +395,36 @@ def _stream_emit(edges2, dsel2, dpar2, cap_out: int, interpret: bool = False,
 
     G = edges2.shape[0]
     T = TILE
+    R = T // 128
+    # the (cap_pad//128, 128) HBM output layout needs 128-aligned capacity
+    # (all engine callers allocate via next_capacity: multiples of 1024)
+    assert cap_out % 128 == 0, f"cap_out must be 128-aligned, got {cap_out}"
     cap_pad = cap_out + T
-    tile = pl.BlockSpec((1, T), lambda t: (t, 0), memory_space=pltpu.VMEM)
+    # Mosaic requires the last two block dims to be (8k, 128m) or exactly
+    # the array dims; a [G, T] layout with (1, T) blocks violates the
+    # sublane rule for every G > 1 (first real-silicon lesson, round 5).
+    # Carrying the tiles as [G, R, 128] makes the block (1, R, 128) — last
+    # two dims == array dims — which lowers.
+    edges2 = edges2.reshape(G, R, 128)
+    dsel2 = dsel2.reshape(G, R, 128)
+    dpar2 = dpar2.reshape(G, R, 128)
+    tile = pl.BlockSpec((1, R, 128), lambda t: (t, 0, 0),
+                        memory_space=pltpu.VMEM)
     kern = partial(_emit_kernel, cap_pad=cap_pad,
                    mxu=USE_MXU_COMPACT if mxu is None else mxu)
     val, par, total = pl.pallas_call(
         kern,
         grid=(G,),
         in_specs=[tile, tile, tile],
-        out_shape=(jax.ShapeDtypeStruct((cap_pad, 1), jnp.int32),
-                   jax.ShapeDtypeStruct((cap_pad, 1), jnp.int32),
+        out_shape=(jax.ShapeDtypeStruct((cap_pad // 128, 128), jnp.int32),
+                   jax.ShapeDtypeStruct((cap_pad // 128, 128), jnp.int32),
                    jax.ShapeDtypeStruct((1, 1), jnp.int32)),
         out_specs=(pl.BlockSpec(memory_space=pl.ANY),
                    pl.BlockSpec(memory_space=pl.ANY),
                    pl.BlockSpec(memory_space=pltpu.SMEM)),
         scratch_shapes=[
-            pltpu.VMEM((2, T, 1), jnp.int32),  # stage_val
-            pltpu.VMEM((2, T, 1), jnp.int32),  # stage_par
+            pltpu.VMEM((2, T // 128, 128), jnp.int32),  # stage_val
+            pltpu.VMEM((2, T // 128, 128), jnp.int32),  # stage_par
             pltpu.VMEM((2 * T, 1), jnp.int32),  # acc_val
             pltpu.VMEM((2 * T, 1), jnp.int32),  # acc_par
             pltpu.SemaphoreType.DMA((2, 2)),
@@ -403,7 +436,7 @@ def _stream_emit(edges2, dsel2, dpar2, cap_out: int, interpret: bool = False,
         ),
         interpret=interpret,
     )(edges2, dsel2, dpar2)
-    return val, par, total
+    return val.reshape(cap_pad, 1), par.reshape(cap_pad, 1), total
 
 
 # ---------------------------------------------------------------------------
@@ -475,8 +508,8 @@ def _emit_kernel_m(edges_ref, dsel_ref, drow_ref,
             (q_r >> 16).reshape(T, 1), (q_r & 0xFFFF).reshape(T, 1),
             jnp.ones((T, 1), jnp.int32),
         ], axis=1).astype(jnp.float32)  # (T, 5)
-        out5 = jnp.dot(mf, halves,
-                       preferred_element_type=jnp.float32).astype(jnp.int32)
+        out5 = jnp.dot(mf, halves, preferred_element_type=jnp.float32,
+                       precision=jax.lax.Precision.HIGHEST).astype(jnp.int32)
         cov = out5[:, 4:5]  # covered-row indicator (0/1)
         acc_val[...] = acc_val[...] + (out5[:, 0:1] * jnp.int32(1 << 16)
                                        + out5[:, 1:2])
@@ -540,24 +573,33 @@ def _stream_emit_m(edges2, dsel2, drow2, cap_out: int, interpret: bool = False,
 
     G = edges2.shape[0]
     T = TILE
+    R = T // 128
     A = (mdup + 1) * T
+    # same 128-aligned capacity precondition as _stream_emit
+    assert cap_out % 128 == 0, f"cap_out must be 128-aligned, got {cap_out}"
     cap_pad = cap_out + A
-    tile = pl.BlockSpec((1, T), lambda t: (t, 0), memory_space=pltpu.VMEM)
+    # same [G, R, 128] layout as _stream_emit — see the Mosaic block-dim
+    # note there
+    edges2 = edges2.reshape(G, R, 128)
+    dsel2 = dsel2.reshape(G, R, 128)
+    drow2 = drow2.reshape(G, R, 128)
+    tile = pl.BlockSpec((1, R, 128), lambda t: (t, 0, 0),
+                        memory_space=pltpu.VMEM)
     kern = partial(_emit_kernel_m, cap_pad=cap_pad,
                    mxu=USE_MXU_COMPACT if mxu is None else mxu, mdup=mdup)
     val, rowpos, total = pl.pallas_call(
         kern,
         grid=(G,),
         in_specs=[tile, tile, tile],
-        out_shape=(jax.ShapeDtypeStruct((cap_pad, 1), jnp.int32),
-                   jax.ShapeDtypeStruct((cap_pad, 1), jnp.int32),
+        out_shape=(jax.ShapeDtypeStruct((cap_pad // 128, 128), jnp.int32),
+                   jax.ShapeDtypeStruct((cap_pad // 128, 128), jnp.int32),
                    jax.ShapeDtypeStruct((1, 1), jnp.int32)),
         out_specs=(pl.BlockSpec(memory_space=pl.ANY),
                    pl.BlockSpec(memory_space=pl.ANY),
                    pl.BlockSpec(memory_space=pltpu.SMEM)),
         scratch_shapes=[
-            pltpu.VMEM((2, T, 1), jnp.int32),  # stage_val
-            pltpu.VMEM((2, T, 1), jnp.int32),  # stage_row
+            pltpu.VMEM((2, T // 128, 128), jnp.int32),  # stage_val
+            pltpu.VMEM((2, T // 128, 128), jnp.int32),  # stage_row
             pltpu.VMEM((A, 1), jnp.int32),     # acc_val
             pltpu.VMEM((A, 1), jnp.int32),     # acc_row
             pltpu.SemaphoreType.DMA((2, 2)),
@@ -569,7 +611,7 @@ def _stream_emit_m(edges2, dsel2, drow2, cap_out: int, interpret: bool = False,
         ),
         interpret=interpret,
     )(edges2, dsel2, drow2)
-    return val, rowpos, total
+    return val.reshape(cap_pad, 1), rowpos.reshape(cap_pad, 1), total
 
 
 # ---------------------------------------------------------------------------
