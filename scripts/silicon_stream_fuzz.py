@@ -33,9 +33,16 @@ def _check(sk, ss, sd, e, cur, n, live, cap, mdup=MDUP, mxu=None,
     assert int(at) == int(bt), f"totals {int(at)} != {int(bt)}"
     assert int(an) == int(bn), f"out_n {int(an)} != {int(bn)}"
     k = int(an)
-    if int(at) <= cap:
+    if expect_bitwise:
+        # same contract as the interpret-mode suite (full-array equality,
+        # padding included): a DMA block landing at a wrong-but-content-
+        # compensating offset must fail here, not pass as a bag
+        assert np.array_equal(av, bv) and np.array_equal(ap, bp), \
+            'bitwise mismatch'
+    elif int(at) <= cap:
         assert (sorted(zip(av[:k].tolist(), ap[:k].tolist()))
-                == sorted(zip(bv[:k].tolist(), bp[:k].tolist()))), 'bag mismatch'
+                == sorted(zip(bv[:k].tolist(), bp[:k].tolist()))), \
+            'bag mismatch'
     return int(at), int(an)
 
 adv._check = _check
