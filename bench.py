@@ -371,6 +371,39 @@ def _ab_partials(scale: int, qn: str, store: dict) -> dict:
     return out
 
 
+def _drop_partial(scale: int, qn: str, backend: str,
+                  above_batch: int) -> None:
+    """Remove banked entries (current + legacy key) that an OOM
+    batch-halving restart just invalidated: anything provisional, or
+    measured at a batch above the size we are falling back to, claims a
+    configuration this chip just refused — and _record_partial's
+    keep-the-min rule would otherwise let its lower per-query latency
+    mask the honest smaller-batch result forever. Complete entries at or
+    below the new batch stay."""
+    import fcntl
+
+    def _stale(d: dict) -> bool:
+        return bool(d.get("provisional")) or d.get("batch", 0) > above_batch
+
+    try:
+        with open(PARTIAL_PATH + ".lock", "w") as lk:
+            fcntl.flock(lk, fcntl.LOCK_EX)
+            store = _load_partial()
+            keys = [_partial_key(scale, qn, backend),
+                    _legacy_partial_key(scale, qn, backend)]
+            hit = [k for k in keys
+                   if k and k in store and _stale(store[k])]
+            if hit:
+                for k in hit:
+                    del store[k]
+                tmp = PARTIAL_PATH + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump(store, f, indent=1, sort_keys=True)
+                os.replace(tmp, PARTIAL_PATH)
+    except Exception as e:
+        print(f"# partial drop failed: {e}", file=sys.stderr)
+
+
 def _best_tpu_partial(scale: int, qn: str, store: dict | None = None) -> dict | None:
     store = _load_partial() if store is None else store
     d = store.get(_partial_key(scale, qn, "tpu"))
@@ -960,6 +993,12 @@ def _measure_one(qn: str, scale: int) -> dict:
                 bq = max(bq // 2, 1)
                 print(f"# {qn}: OOM, retrying at batch={bq}",
                       file=sys.stderr, flush=True)
+                # any provisional stub banked at the unsustainable larger
+                # batch must not outlive the restart (its lower per-query
+                # us would mask the honest smaller-batch result)
+                _drop_partial(scale, qn,
+                              os.environ.get("WUKONG_BENCH_BACKEND", "tpu"),
+                              above_batch=bq)
                 best = None
                 trial = 0
                 warmed = False
@@ -1775,6 +1814,24 @@ def main():
             "qps": emu_detail["value"], "backend": emu_detail["backend"],
             "vs_baseline_qps": emu_detail["vs_baseline"],
             "metric": emu_detail["metric"]}
+
+    # ladder rungs below the target scale bank real on-chip evidence that
+    # must stay OUT of the headline geomean (different workload) but IN
+    # the artifact: a degraded-relay round's only TPU numbers may live at
+    # LUBM-40/160. _best_tpu_partial applies the store's own freshness /
+    # dataset-version / toggles contracts — stale or regenerated-world
+    # entries never surface here
+    other_tpu = {}
+    for s2 in (40, 160, 2560):
+        if s2 == target_scale:
+            continue
+        per = {qn2: b["us"] for qn2 in queries
+               if (b := _best_tpu_partial(s2, qn2, partial_store))
+               and "us" in b}
+        if per:
+            other_tpu[str(s2)] = per
+    if other_tpu:
+        details["tpu_at_other_scales_us"] = other_tpu
 
     excl = [qn for qn in queries
             if isinstance(details.get(qn), dict)
