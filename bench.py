@@ -415,6 +415,29 @@ def _best_tpu_partial(scale: int, qn: str, store: dict | None = None) -> dict | 
     return dict(d)
 
 
+LADDER_SCALES = (40, 160, 2560)  # bench_loop.sh rungs
+
+
+def _other_scale_tpu_evidence(target_scale: int, queries: list,
+                              store: dict) -> dict:
+    """Best banked on-chip numbers at every ladder rung OTHER than the
+    target scale: real evidence on a degraded-relay round (whose only TPU
+    captures may live at LUBM-40/160), kept OUT of the headline geomean —
+    a different scale is a different workload — but IN the artifact.
+    _best_tpu_partial applies the store's freshness / dataset-version /
+    toggles contracts, so stale or regenerated-world entries never
+    surface."""
+    other = {}
+    for s2 in LADDER_SCALES:
+        if s2 == target_scale:
+            continue
+        per = {qn: b["us"] for qn in queries
+               if (b := _best_tpu_partial(s2, qn, store)) and "us" in b}
+        if per:
+            other[str(s2)] = per
+    return other
+
+
 REF_EMU_QPS_LUBM2560 = 73_400.0  # 1-node sparql-emu A1-A6 @ p=30
 # (docs/performance/S1C24-LUBM2560-20181203.md:139-145)
 
@@ -1815,21 +1838,8 @@ def main():
             "vs_baseline_qps": emu_detail["vs_baseline"],
             "metric": emu_detail["metric"]}
 
-    # ladder rungs below the target scale bank real on-chip evidence that
-    # must stay OUT of the headline geomean (different workload) but IN
-    # the artifact: a degraded-relay round's only TPU numbers may live at
-    # LUBM-40/160. _best_tpu_partial applies the store's own freshness /
-    # dataset-version / toggles contracts — stale or regenerated-world
-    # entries never surface here
-    other_tpu = {}
-    for s2 in (40, 160, 2560):
-        if s2 == target_scale:
-            continue
-        per = {qn2: b["us"] for qn2 in queries
-               if (b := _best_tpu_partial(s2, qn2, partial_store))
-               and "us" in b}
-        if per:
-            other_tpu[str(s2)] = per
+    other_tpu = _other_scale_tpu_evidence(target_scale, queries,
+                                          partial_store)
     if other_tpu:
         details["tpu_at_other_scales_us"] = other_tpu
 

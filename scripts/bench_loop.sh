@@ -21,25 +21,37 @@ export WUKONG_CACHE_DIR="$REPO/.cache"
 export WUKONG_PROBE_TIMEOUT=90
 cd "$SNAP" || exit 1
 PASS=0
-banked_at() {  # count persisted TPU partials at scale $1
-  # second arg "default": only entries measured under default kernel
-  # toggles (the helper runs OUTSIDE `env $AB`, so bench._toggles_key()
-  # is the default string) — the A/B gate must not fire on arm-run or
-  # pre-ladder entries
-  # the gates at the call sites are numeric [ -gt ] tests: ANY failure here
-  # must still print a well-formed 0, or the tests become bash syntax
+banked_at() {  # TPU-partial evidence at scale $1
+  # mode (arg 2): "any" counts :tpu: keys; "default" counts only entries
+  # measured under default kernel toggles (the helper runs OUTSIDE
+  # `env $AB`, so bench._toggles_key() is the default string — imported
+  # only in this mode, so the escalation gates never depend on bench
+  # importability); "sig" prints a hash over (key, us, ts) of the scale's
+  # :tpu: entries — it changes when a pass banks a NEW key or IMPROVES an
+  # existing one (_record_partial refreshes ts on replacement), and stays
+  # put across passes that bank nothing, stale history included.
+  # the gates at the call sites are numeric/string [ ] tests: ANY failure
+  # here must still print a well-formed 0, or the tests become bash
   # errors that silently disable escalation and the A/B arms
   python - "$1" "${2:-any}" <<'EOF' 2>/dev/null || echo 0
-import json, os, sys
+import hashlib, json, os, sys
 try:
     store = json.load(open(os.path.join(os.environ["WUKONG_CACHE_DIR"],
                                         "bench_partial.json")))
     scale, mode = sys.argv[1], sys.argv[2]
-    sys.path.insert(0, os.getcwd())
-    from bench import _toggles_key
-    suffix = f":tpu:{_toggles_key()}" if mode == "default" else ":tpu:"
-    print(sum(1 for k in store
-              if k.startswith(f"lubm{scale}v") and suffix in k))
+    if mode == "default":
+        sys.path.insert(0, os.getcwd())
+        from bench import _toggles_key
+        suffix = f":tpu:{_toggles_key()}"
+    else:
+        suffix = ":tpu:"
+    hits = {k: (store[k].get("us"), store[k].get("ts")) for k in store
+            if k.startswith(f"lubm{scale}v") and suffix in k}
+    if mode == "sig":
+        blob = json.dumps(sorted(hits.items())).encode()
+        print(int(hashlib.sha256(blob).hexdigest()[:12], 16) if hits else 0)
+    else:
+        print(len(hits))
 except Exception:
     print(0)
 EOF
@@ -66,19 +78,19 @@ sys.exit(0 if jax.devices()[0].platform != 'cpu' else 1)" >/dev/null 2>&1; then
       esac
     fi
     echo "[$(date +%F' '%T)] backend healthy -> bench @ LUBM-$SCALE rung=$RUNG ${AB:-default}" >> "$LOG"
-    BEFORE=$(banked_at "$SCALE")
+    BEFORE=$(banked_at "$SCALE" sig)
     env $AB WUKONG_BENCH_SCALE=$SCALE WUKONG_QUERY_TIMEOUT=$QT \
         WUKONG_BENCH_DEADLINE=9000 timeout 10800 python bench.py >> "$LOG" 2>&1
     rc=$?  # captured before $(date) in the echo resets $?
-    AFTER=$(banked_at "$SCALE")
-    echo "[$(date +%F' '%T)] bench pass done (rc=$rc, banked $BEFORE->$AFTER at $SCALE)" >> "$LOG"
-    # escalate on newly-banked on-chip keys, OR on a fully-completed pass
-    # (rc=0) that has on-chip evidence at this scale — a healthy pass that
-    # only IMPROVES already-banked entries leaves the key count unchanged
-    # but still proves this rung serves. bench exits 0 on its internal
-    # cpu-fallback too, hence the AFTER>0 guard: banked :tpu: keys only.
-    if { [ "$AFTER" -gt "$BEFORE" ] || { [ "$rc" -eq 0 ] && [ "$AFTER" -gt 0 ]; }; } \
-        && [ "$RUNG" -lt 2 ]; then
+    AFTER=$(banked_at "$SCALE" sig)
+    echo "[$(date +%F' '%T)] bench pass done (rc=$rc, sig $BEFORE->$AFTER at $SCALE)" >> "$LOG"
+    # escalate only when THIS pass changed the scale's on-chip evidence
+    # (new key banked, or an existing entry improved — both move the sig;
+    # _record_partial refreshes ts on replacement). Stale history alone
+    # never escalates: a cpu-fallback-only pass leaves :tpu: entries
+    # untouched, sig stays put, and the ladder keeps collecting at the
+    # scale the relay can actually serve.
+    if [ "$AFTER" != "$BEFORE" ] && [ "$AFTER" != 0 ] && [ "$RUNG" -lt 2 ]; then
       echo $((RUNG + 1)) > "$RUNG_FILE"
       echo "[$(date +%F' '%T)] rung escalated to $((RUNG + 1))" >> "$LOG"
     fi

@@ -87,3 +87,65 @@ def test_side_file_failure_does_not_kill_headline(bench, monkeypatch,
                        "detail": {"q": {"us": 1.0}}}, "SIDE.json")
     head = json.loads(_last_line(capsys))
     assert head["value"] == 1 and "detail_file" not in head
+
+
+# ---------------------------------------------------------------------------
+# Partial-store contracts behind the flaky-relay capture path: provisional
+# stubs bank per trial, OOM restarts invalidate what they disprove, and
+# ladder-rung evidence surfaces without violating freshness/version rules.
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def pstore(bench, tmp_path, monkeypatch):
+    monkeypatch.setattr(bench, "CACHE", str(tmp_path))
+    monkeypatch.setattr(bench, "PARTIAL_PATH",
+                        str(tmp_path / "bench_partial.json"))
+    return bench
+
+
+def test_oom_drop_removes_provisional_stub(pstore):
+    pstore._record_partial(40, "lubm_q1", "tpu",
+                           {"us": 80.2, "batch": 1024, "provisional": True})
+    assert pstore._best_tpu_partial(40, "lubm_q1") is not None
+    pstore._drop_partial(40, "lubm_q1", "tpu", above_batch=512)
+    assert pstore._best_tpu_partial(40, "lubm_q1") is None
+
+
+def test_oom_drop_keeps_smaller_batch_complete_entry(pstore):
+    pstore._record_partial(40, "lubm_q2", "tpu", {"us": 99.0, "batch": 256})
+    pstore._drop_partial(40, "lubm_q2", "tpu", above_batch=512)
+    got = pstore._best_tpu_partial(40, "lubm_q2")
+    assert got is not None and got["us"] == 99.0
+
+
+def test_oom_drop_removes_larger_batch_complete_entry(pstore):
+    # a complete entry at a batch the chip just refused claims a
+    # configuration this process disproved
+    pstore._record_partial(40, "lubm_q3", "tpu", {"us": 50.0, "batch": 1024})
+    pstore._drop_partial(40, "lubm_q3", "tpu", above_batch=512)
+    assert pstore._best_tpu_partial(40, "lubm_q3") is None
+
+
+def test_other_scale_evidence_filters_stale_and_groups(pstore, tmp_path):
+    import json as _json
+
+    queries = [f"lubm_q{i}" for i in range(1, 8)]
+    pstore._record_partial(40, "lubm_q4", "tpu", {"us": 5.0, "batch": 1024})
+    pstore._record_partial(160, "lubm_q7", "tpu", {"us": 7.0, "batch": 64})
+    pstore._record_partial(40, "lubm_q5", "cpu", {"us": 2.0, "batch": 1024})
+    # stale entry: must never surface (freshness contract)
+    store = pstore._load_partial()
+    key = pstore._partial_key(160, "lubm_q6", "tpu")
+    store[key] = {"us": 1.0, "batch": 8, "ts": "2020-01-01T00:00:00"}
+    with open(tmp_path / "bench_partial.json", "w") as f:
+        _json.dump(store, f)
+    got = pstore._other_scale_tpu_evidence(
+        2560, queries, pstore._load_partial())
+    assert got == {"40": {"lubm_q4": 5.0}, "160": {"lubm_q7": 7.0}}
+    # entries at the target scale itself are excluded (they feed the
+    # headline geomean instead)
+    pstore._record_partial(2560, "lubm_q1", "tpu", {"us": 9.0, "batch": 2})
+    got = pstore._other_scale_tpu_evidence(
+        2560, queries, pstore._load_partial())
+    assert "lubm_q1" not in got.get("2560", {})
