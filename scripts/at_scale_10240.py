@@ -17,7 +17,9 @@ with versatile off (HBM_BUDGET.md "LUBM-10240 exact planning headers").
 Usage: detached, one at a time on this 1-core host:
   setsid python scripts/at_scale_10240.py > .cache/at10240.log 2>&1 &
 Env: WUKONG_10240_QUERIES (csv, default q4,q5,q6,q3,q2,q7,q1),
-     WUKONG_10240_BUDGET_S (wall budget for the heavy loop, default 7200),
+     WUKONG_10240_BUDGET_S (wall budget for the query/oracle loop,
+     counted from store-build completion — the build pipeline alone is
+     hours at this scale; default 7200),
      WUKONG_ORACLE_TIMEOUT (heavy CPU-oracle box, default 3600).
 """
 
@@ -62,28 +64,78 @@ def main() -> None:
     from wukong_tpu.utils.compilecache import setup_persistent_cache
 
     setup_persistent_cache()
-    t0 = time.time()
     budget_s = int(os.environ.get("WUKONG_10240_BUDGET_S", "7200"))
     qnames = [f"lubm_{q}" if not q.startswith("lubm") else q
               for q in os.environ.get(
                   "WUKONG_10240_QUERIES",
                   "q4,q5,q6,q3,q2,q7,q1").split(",")]
 
-    log("synthesizing LUBM-10240")
-    triples, _lay = generate_lubm(SCALE, seed=0)
-    log(f"{len(triples):,} triples")
-    # ids < 2^31 by the store contract (gstore.check_vid_range) — asserted
-    # HERE because Stats.generate consumes the narrowed array long before
-    # build_partition would catch a silent wrap. int32 halves every
-    # downstream sort/copy — the int64 run OOMed at 130 GB
-    assert int(triples.max()) < 2**31 - 1, "ids overflow int32"
-    triples = triples.astype(np.int32)
-    log("narrowed to int32")
-    stats = Stats.generate(triples)
-    log("stats done")
+    # disk-space-gated caches: generation + stats are ~75 min of 1-core
+    # work per attempt; a crash or budget misjudgment must not pay them
+    # twice. The int32 triples npy is ~15 GB, the stats npz ~5 GB — both
+    # skipped when free disk is short (the in-RAM path still works).
+    tri_cache = os.path.join(REPO, ".cache", f"lubm{SCALE}_i32_triples.npy")
+    stats_cache = os.path.join(REPO, ".cache", f"lubm{SCALE}_stats.npz")
+
+    def _free_gb(path=REPO) -> float:
+        st = os.statvfs(path)
+        return st.f_bavail * st.f_frsize / 2**30
+
+    triples = None
+    if os.path.exists(tri_cache):
+        log(f"loading cached triples {tri_cache}")
+        try:
+            triples = np.load(tri_cache)
+        except Exception as e:  # truncated/corrupt cache: regenerate
+            log(f"triples cache unreadable ({e}); regenerating")
+            os.unlink(tri_cache)
+    if triples is None:
+        log("synthesizing LUBM-10240")
+        triples, _lay = generate_lubm(SCALE, seed=0)
+        log(f"{len(triples):,} triples")
+        # ids < 2^31 by the store contract (gstore.check_vid_range) —
+        # asserted HERE because Stats.generate consumes the narrowed array
+        # long before build_partition would catch a silent wrap. int32
+        # halves every downstream sort/copy — the int64 run OOMed at 130 GB
+        assert int(triples.max()) < 2**31 - 1, "ids overflow int32"
+        triples = triples.astype(np.int32)
+        log("narrowed to int32")
+        need = triples.nbytes / 2**30 + 2
+        if _free_gb() > need + 10:
+            try:  # tmp + rename: a crash/ENOSPC mid-save must never leave
+                # a truncated cache that aborts every later run at startup
+                np.save(tri_cache + ".tmp.npy", triples)
+                os.replace(tri_cache + ".tmp.npy", tri_cache)
+                log(f"triples cached ({triples.nbytes / 2**30:.1f} GB)")
+            except Exception as e:
+                log(f"triples cache save failed: {e}")
+        else:
+            log(f"triples cache skipped (free {_free_gb():.0f} GB)")
+    stats = None
+    if os.path.exists(stats_cache):
+        try:
+            stats = Stats.load(stats_cache)
+            log("stats loaded from cache")
+        except Exception as e:
+            log(f"stats cache unreadable ({e}); regenerating")
+            os.unlink(stats_cache)
+    if stats is None:
+        stats = Stats.generate(triples)
+        log("stats done")
+        if _free_gb() > 20:
+            try:
+                stats.save(stats_cache + ".tmp")
+                os.replace(stats_cache + ".tmp.npz", stats_cache)
+                log("stats cached")
+            except Exception as e:
+                log(f"stats cache save failed: {e}")
     g = build_partition(triples, 0, 1, versatile=False)
     log(f"store built: {g.stats_str()}")
     del triples
+    # the query/oracle budget starts NOW: at this scale the build pipeline
+    # alone exceeds the old from-process-start budget, which would have
+    # skipped every query and emitted an empty artifact
+    t0 = time.time()
 
     ss = VirtualLubmStrings(SCALE, seed=0)
     eng = TPUEngine(g, ss, stats=stats)

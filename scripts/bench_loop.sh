@@ -79,18 +79,27 @@ sys.exit(0 if jax.devices()[0].platform != 'cpu' else 1)" >/dev/null 2>&1; then
     fi
     echo "[$(date +%F' '%T)] backend healthy -> bench @ LUBM-$SCALE rung=$RUNG ${AB:-default}" >> "$LOG"
     BEFORE=$(banked_at "$SCALE" sig)
+    PASS_LOG=$(mktemp)
     env $AB WUKONG_BENCH_SCALE=$SCALE WUKONG_QUERY_TIMEOUT=$QT \
-        WUKONG_BENCH_DEADLINE=9000 timeout 10800 python bench.py >> "$LOG" 2>&1
-    rc=$?  # captured before $(date) in the echo resets $?
+        WUKONG_BENCH_DEADLINE=9000 timeout 10800 python bench.py > "$PASS_LOG" 2>&1
+    rc=$?  # captured before anything else resets $?
+    cat "$PASS_LOG" >> "$LOG"
     AFTER=$(banked_at "$SCALE" sig)
-    echo "[$(date +%F' '%T)] bench pass done (rc=$rc, sig $BEFORE->$AFTER at $SCALE)" >> "$LOG"
-    # escalate only when THIS pass changed the scale's on-chip evidence
-    # (new key banked, or an existing entry improved — both move the sig;
-    # _record_partial refreshes ts on replacement). Stale history alone
-    # never escalates: a cpu-fallback-only pass leaves :tpu: entries
-    # untouched, sig stays put, and the ladder keeps collecting at the
-    # scale the relay can actually serve.
-    if [ "$AFTER" != "$BEFORE" ] && [ "$AFTER" != 0 ] && [ "$RUNG" -lt 2 ]; then
+    # on-chip proof for this pass: the final headline labels backend tpu
+    # only when every surviving query has on-chip evidence passing the
+    # 24h freshness filter (prior-ROUND history can't fake it)
+    ONCHIP=0
+    [ "$rc" -eq 0 ] && tail -1 "$PASS_LOG" | grep -q '"backend": *"tpu"' && ONCHIP=1
+    rm -f "$PASS_LOG"
+    echo "[$(date +%F' '%T)] bench pass done (rc=$rc, sig $BEFORE->$AFTER, onchip=$ONCHIP at $SCALE)" >> "$LOG"
+    # escalate when THIS pass changed the scale's on-chip evidence (new
+    # key banked or an entry improved — both move the sig), OR when a
+    # fully-green pass proved the whole rung serves on-chip even without
+    # beating the banked bests (sig alone would wedge the ladder at a low
+    # rung forever once good numbers are on file). A cpu-fallback-only
+    # pass moves neither: sig stays put and the headline says cpu.
+    if { { [ "$AFTER" != "$BEFORE" ] && [ "$AFTER" != 0 ]; } || [ "$ONCHIP" = 1 ]; } \
+        && [ "$RUNG" -lt 2 ]; then
       echo $((RUNG + 1)) > "$RUNG_FILE"
       echo "[$(date +%F' '%T)] rung escalated to $((RUNG + 1))" >> "$LOG"
     fi
