@@ -100,3 +100,80 @@ def test_parse_rejects_ragged_lines(lib, tmp_path):
     path.write_text("1\t2\t3\n4\t5\n6\t7\t8\n")  # middle line truncated
     with pytest.raises(ValueError):
         native.parse_id_triples(str(path))
+
+
+def test_sort_triples_int32_path_matches_lexsort(lib):
+    """The int32 variant (billion-triple builds: no upcast copies, int32
+    perm/scratch) must produce the same stable permutation as lexsort and
+    the int64 path."""
+    rng = np.random.default_rng(3)
+    n = 100000
+    p = rng.integers(0, 40, n).astype(np.int32)
+    s = rng.integers(0, 2**31 - 2, n).astype(np.int32)
+    o = rng.integers(0, 2**31 - 2, n).astype(np.int32)
+    perm = native.sort_triples_perm(p, s, o)
+    assert perm is not None and perm.dtype == np.int32
+    want = np.lexsort((o, s, p))
+    assert np.array_equal(perm.astype(np.int64), want)
+    # mixed dtypes fall back to the int64 path, same order
+    perm64 = native.sort_triples_perm(p.astype(np.int64), s, o)
+    assert perm64.dtype == np.int64
+    assert np.array_equal(perm64, want)
+
+
+def test_sort_triples_int32_stability_on_equal_keys(lib):
+    one = np.zeros(7, np.int32)
+    t3 = np.arange(7, dtype=np.int32)
+    perm = native.sort_triples_perm(one, one, t3)
+    assert np.array_equal(perm.astype(np.int64), np.arange(7))
+    # all three equal: identity (stability)
+    perm = native.sort_triples_perm(one, one, one)
+    assert np.array_equal(perm.astype(np.int64), np.arange(7))
+
+
+def test_store_build_int32_triples_matches_int64(lib):
+    """build_partition on int32 triples (the at-scale diet) must produce
+    stores identical to the int64 build — including TYPE_ID triples, the
+    type index, and every VERSATILE structure (the exact paths the
+    one-direction-at-a-time build reorder hoisted)."""
+    from wukong_tpu.store.gstore import build_partition
+    from wukong_tpu.types import IN, OUT, TYPE_ID
+
+    rng = np.random.default_rng(4)
+    n = 20000
+    NORM = 1 << 17
+    triples = np.stack([
+        rng.integers(NORM, NORM + 5000, n),
+        rng.integers(2, 30, n),
+        rng.integers(NORM, NORM + 5000, n),
+    ], axis=1)
+    # type triples: (s, TYPE_ID, type-id) with type ids below NORMAL_ID_START
+    ttr = np.stack([
+        rng.integers(NORM, NORM + 5000, 3000),
+        np.full(3000, TYPE_ID),
+        rng.integers(2, 12, 3000),
+    ], axis=1)
+    triples = np.concatenate([triples, ttr])
+    g64 = build_partition(triples.astype(np.int64), 0, 2, versatile=True)
+    g32 = build_partition(triples.astype(np.int32), 0, 2, versatile=True)
+    assert (TYPE_ID, OUT) in g64.segments  # the fixture really has types
+    assert set(g64.segments) == set(g32.segments)
+    for k in g64.segments:
+        a, b = g64.segments[k], g32.segments[k]
+        assert np.array_equal(a.keys, np.asarray(b.keys, np.int64))
+        assert np.array_equal(a.offsets, b.offsets)
+        assert np.array_equal(a.edges, np.asarray(b.edges, np.int64))
+    assert set(g64.index) == set(g32.index)
+    for k in g64.index:
+        assert np.array_equal(g64.index[k],
+                              np.asarray(g32.index[k], np.int64))
+    assert g64.type_ids == g32.type_ids
+    # versatile: vp CSRs + v/t/p sets
+    for d in (OUT, IN):
+        a, b = g64.vp[d], g32.vp[d]
+        assert np.array_equal(a.keys, np.asarray(b.keys, np.int64))
+        assert np.array_equal(a.offsets, b.offsets)
+        assert np.array_equal(a.edges, np.asarray(b.edges, np.int64))
+    assert np.array_equal(g64.v_set, np.asarray(g32.v_set, np.int64))
+    assert np.array_equal(g64.t_set, np.asarray(g32.t_set, np.int64))
+    assert np.array_equal(g64.p_set, np.asarray(g32.p_set, np.int64))

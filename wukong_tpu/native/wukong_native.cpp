@@ -143,28 +143,33 @@ int build_bucket_table(const int64_t *keys, const int64_t *offsets, long K,
 // Radix sort of triples by (p, s, o) or (p, o, s) — the loader's sorted runs
 // ---------------------------------------------------------------------------
 
-static void radix_pass(const int64_t *key, const long *in, long *out, long n,
-                       int shift) {
+}  // extern "C" (templates need C++ linkage; the exported sort entry
+   //              points reopen the C block below)
+
+// One template at both widths. K = key dtype, I = permutation-index dtype:
+// (int64, long) is the general path; (int32, int32) is the billion-triple
+// diet — the int64 path costs ~60 GB of transients at LUBM-10240 (three
+// int64 upcasts of the int32 columns + an int64 perm + two long[n] scratch
+// vectors) while the int32 instantiation reads the columns in place and
+// keeps perm/scratch at int32, ~4x less. Keys must be non-negative (the
+// store's check_vid_range contract: ids < 2^31), so the unsigned digit
+// extraction below agrees with signed order at both widths; the int32
+// index form additionally needs n < 2^31.
+template <typename K, typename I>
+static void radix_pass(const K *key, const I *in, I *out, long n, int shift) {
     long counts[65536] = {0};
     for (long i = 0; i < n; i++)
-        counts[(key[in[i]] >> shift) & 0xFFFF]++;
+        counts[((uint64_t)key[in[i]] >> shift) & 0xFFFF]++;
     long pos = 0;
     long starts[65536];
     for (int b = 0; b < 65536; b++) { starts[b] = pos; pos += counts[b]; }
     for (long i = 0; i < n; i++)
-        out[starts[(key[in[i]] >> shift) & 0xFFFF]++] = in[i];
+        out[starts[((uint64_t)key[in[i]] >> shift) & 0xFFFF]++] = in[i];
 }
 
-static void argsort_radix(const int64_t *key, long *perm, long *tmp, long n,
-                          int max_bits) {
-    for (int shift = 0; shift < max_bits; shift += 16) {
-        radix_pass(key, perm, tmp, n, shift);
-        std::memcpy(perm, tmp, (size_t)n * sizeof(long));
-    }
-}
-
-static int bits_needed(const int64_t *a, long n) {
-    int64_t mx = 0;
+template <typename K>
+static int bits_needed(const K *a, long n) {
+    K mx = 0;
     for (long i = 0; i < n; i++)
         if (a[i] > mx) mx = a[i];
     int b = 0;
@@ -176,17 +181,31 @@ static int bits_needed(const int64_t *a, long n) {
 // Stable sort permutation for triples by (primary, secondary, tertiary).
 // LSD passes sized by each column's actual bit width (predicate ids fit one
 // pass; vids typically two or three).
+template <typename K, typename I>
+static void sort_triples_impl(const K *tertiary, const K *secondary,
+                              const K *primary, long n, I *perm_out) {
+    std::vector<I> tmp((size_t)n);
+    for (long i = 0; i < n; i++) perm_out[i] = (I)i;
+    const K *keys[3] = {tertiary, secondary, primary};
+    for (int k = 0; k < 3; k++) {
+        int bits = bits_needed(keys[k], n);
+        for (int shift = 0; shift < bits; shift += 16) {
+            radix_pass(keys[k], perm_out, tmp.data(), n, shift);
+            std::memcpy(perm_out, tmp.data(), (size_t)n * sizeof(I));
+        }
+    }
+}
+
+extern "C" {
+
 void sort_triples(const int64_t *tertiary, const int64_t *secondary,
                   const int64_t *primary, long n, int64_t *perm_out) {
-    std::vector<long> perm((size_t)n), tmp((size_t)n);
-    for (long i = 0; i < n; i++) perm[(size_t)i] = i;
-    argsort_radix(tertiary, perm.data(), tmp.data(), n,
-                  bits_needed(tertiary, n));
-    argsort_radix(secondary, perm.data(), tmp.data(), n,
-                  bits_needed(secondary, n));
-    argsort_radix(primary, perm.data(), tmp.data(), n,
-                  bits_needed(primary, n));
-    for (long i = 0; i < n; i++) perm_out[i] = (int64_t)perm[(size_t)i];
+    sort_triples_impl(tertiary, secondary, primary, n, perm_out);
+}
+
+void sort_triples32(const int32_t *tertiary, const int32_t *secondary,
+                    const int32_t *primary, long n, int32_t *perm_out) {
+    sort_triples_impl(tertiary, secondary, primary, n, perm_out);
 }
 
 }  // extern "C"

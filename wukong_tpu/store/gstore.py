@@ -168,26 +168,38 @@ def build_partition(triples: np.ndarray, sid: int, num_workers: int,
     if check_ids:
         check_vid_range(triples)
     s, p, o = triples[:, 0], triples[:, 1], triples[:, 2]
-    mine_out = hash_mod(s, num_workers) == sid  # pso copy (subject owner)
-    mine_in = hash_mod(o, num_workers) == sid  # pos copy (object owner)
-
-    so, po, oo = s[mine_out], p[mine_out], o[mine_out]
-    si, pi, oi = s[mine_in], p[mine_in], o[mine_in]
-    # object side never stores type triples as normal edges
-    norm_in = oi >= NORMAL_ID_START
-    si, pi, oi = si[norm_in], pi[norm_in], oi[norm_in]
 
     # ---- normal segments + predicate indexes (one sort per side) ---------
+    # One direction END-TO-END at a time (slice -> sort -> segments ->
+    # free), never both directions' copies plus sort workspace at once:
+    # at LUBM-10240 (1.27B triples, int32) the old both-sides-up-front
+    # layout peaked past this host's 125 GB and the build OOM-killed.
     # pso order: (p, s, o) — each predicate run becomes one OUT segment
+    mine_out = hash_mod(s, num_workers) == sid  # pso copy (subject owner)
+    so, po, oo = s[mine_out], p[mine_out], o[mine_out]
+    del mine_out
     order = _triple_argsort(po, so, oo)
     so, po, oo = so[order], po[order], oo[order]
+    del order
     for pid, ks, vs in _pred_runs(po, so, oo):
         g.segments[(pid, OUT)] = CSRSegment.from_sorted_pairs(ks, vs)
         if pid != TYPE_ID:
             g.index[(pid, IN)] = g.segments[(pid, OUT)].keys.copy()
-    # pos order: (p, o, s) — each predicate run becomes one IN segment
+    if versatile:  # subject-side versatile pieces, before freeing the copies
+        vp_out = CSRSegment.from_pairs(so, po)  # includes TYPE_ID edges
+        v_sub = np.unique(so)
+        p_out = np.unique(po[po != TYPE_ID])
+    del so, po, oo
+
+    # pos order: (p, o, s) — each predicate run becomes one IN segment;
+    # the object side never stores type triples as normal edges (the
+    # NORMAL_ID_START test folds into the owner mask: one copy, not two)
+    mine_in = (hash_mod(o, num_workers) == sid) & (o >= NORMAL_ID_START)
+    si, pi, oi = s[mine_in], p[mine_in], o[mine_in]
+    del mine_in
     order = _triple_argsort(pi, oi, si)
     si, pi, oi = si[order], pi[order], oi[order]
+    del order
     for pid, ks, vs in _pred_runs(pi, oi, si):
         g.segments[(pid, IN)] = CSRSegment.from_sorted_pairs(ks, vs)
         g.index[(pid, OUT)] = g.segments[(pid, IN)].keys.copy()
@@ -205,12 +217,12 @@ def build_partition(triples: np.ndarray, sid: int, num_workers: int,
 
     # ---- VERSATILE -------------------------------------------------------
     if versatile:
-        g.vp[OUT] = CSRSegment.from_pairs(so, po)  # includes TYPE_ID edges
+        g.vp[OUT] = vp_out
         g.vp[IN] = CSRSegment.from_pairs(oi, pi)
-        g.v_set = np.unique(np.concatenate([so, oi]))
+        g.v_set = np.union1d(v_sub, oi)
         g.t_set = (np.unique(tseg.edges) if tseg is not None
                    else np.empty(0, dtype=np.int64))
-        g.p_set = np.unique(np.concatenate([po[po != TYPE_ID], pi]))
+        g.p_set = np.union1d(p_out, pi)
 
     # ---- attributes ------------------------------------------------------
     if attr_triples:
