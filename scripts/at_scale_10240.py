@@ -20,7 +20,9 @@ Env: WUKONG_10240_QUERIES (csv, default q4,q5,q6,q3,q2,q7,q1),
      WUKONG_10240_BUDGET_S (wall budget for the query/oracle loop,
      counted from store-build completion — the build pipeline alone is
      hours at this scale; default 7200),
-     WUKONG_ORACLE_TIMEOUT (heavy CPU-oracle box, default 3600).
+     WUKONG_ORACLE_TIMEOUT (heavy CPU-oracle box, default 3600),
+     WUKONG_10240_CACHE_GB (device-segment cache budget, default 32 —
+     host RAM plays the device here; lower it on smaller hosts).
 """
 
 import json
@@ -64,6 +66,19 @@ def main() -> None:
     from wukong_tpu.utils.compilecache import setup_persistent_cache
 
     setup_persistent_cache()
+    # device-cache budget: the default Global.tpu_mem_cache_gb = 4 models
+    # v5e HBM, but this run's "device" IS host RAM — keeping the 4 GB
+    # budget just measures LRU re-staging of the ~4 GB start segments
+    # (first run: q4 at 139 ms/query, pure restage). The v5e-8 fit
+    # question is answered by BUDGET_10240.json (per-chip 1/8 shards),
+    # not by throttling this artifact.
+    from wukong_tpu.config import Global
+
+    # 32 GB covers the ENTIRE padded store (~28 GB int32) with margin, so
+    # nothing ever restages, while capping worst-case RSS at
+    # store + cache + stats + chain buffers ≈ 75 GB on this 125 GB host
+    Global.tpu_mem_cache_gb = int(
+        os.environ.get("WUKONG_10240_CACHE_GB", "32"))
     budget_s = int(os.environ.get("WUKONG_10240_BUDGET_S", "7200"))
     qnames = [f"lubm_{q}" if not q.startswith("lubm") else q
               for q in os.environ.get(
