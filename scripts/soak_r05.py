@@ -1,0 +1,38 @@
+"""End-of-round-5 soak: fresh seeds through the adversarial stream fuzzer
+and the lookup-dispatch differential fuzzer (run standalone with
+JAX_PLATFORMS=cpu; the committed test suites run the canonical seeds)."""
+
+import sys
+
+sys.path.insert(0, "/root/repo")
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main():
+    import pytest
+
+    import tests.test_merge_path as M
+    import tests.test_stream_adversarial as A
+
+    n = 0
+    for seed in range(200, 240):
+        A.test_adversarial_mix_fuzz(seed)
+        n += 1
+        if n % 10 == 0:
+            print(f"adversarial mix: {n} seeds OK", flush=True)
+    for seed in range(70, 90):
+        mp = pytest.MonkeyPatch()
+        try:
+            M.test_probe_vs_merge_arm_fuzz(seed, mp)
+        finally:
+            mp.undo()
+        n += 1
+        if n % 10 == 0:
+            print(f"progress: {n}", flush=True)
+    print(f"soak complete: {n} extra cases, zero divergence")
+
+
+if __name__ == "__main__":
+    main()

@@ -296,11 +296,13 @@ def test_single_row_single_edge():
 # ---------------------------------------------------------------------------
 # F. randomized adversarial mixes (everything at once)
 # ---------------------------------------------------------------------------
-@pytest.mark.parametrize("seed", range(10))
-def test_adversarial_mix_fuzz(seed):
-    """Random combination of every hostile trait: tile-hostile degrees,
+def _mix_case(seed: int):
+    """One randomized adversarial mix: tile-hostile degrees,
     sentinel-adjacent values, duplicate anchors at random multiplicity,
-    partial live, caps at/below total, random mdup, both backends."""
+    partial live, caps at/below total, random mdup, both backends. Shared
+    by the fuzzer and the seed-pinned regression tests so a pinned seed
+    keeps reproducing ITS scenario even if either test's assertions
+    change (the draw sequence lives here and only here)."""
     rng = np.random.default_rng(7000 + seed)
     nkeys = int(rng.integers(4, 80))
     degs = rng.choice([0, 1, 2, TILE - 1, TILE, TILE + 1, 37], size=nkeys,
@@ -328,5 +330,64 @@ def test_adversarial_mix_fuzz(seed):
     cur, n, _ = _frontier(anchors, C=C)
     cap = int(rng.choice([TILE, 2 * TILE, 1 << 12]))
     mxu = bool(rng.integers(0, 2))
-    _check(sk, ss, sd, e, cur, n, live, cap, mdup=mdup, mxu=mxu,
-           expect_bitwise=(m > mdup))
+    return dict(keys=keys, degs=degs, sk=sk, ss=ss, sd=sd, e=e, cur=cur,
+                n=n, live=live, cap=cap, mdup=mdup, m=m, mxu=mxu)
+
+
+def _expect_bitwise(keys, degs, cur, n, live, mdup) -> bool:
+    """Mirror stream_expand's arm dispatch EXACTLY (tpu_stream.py):
+
+    - `dup` fires on any duplicate LIVE FOUND anchor — key present in the
+      segment, degree irrelevant (the kernel's adjacency test runs before
+      deg filtering);
+    - with duplicates, the m-hot arm runs when `mmax` — the max per-key
+      multiplicity over LIVE, MATCHED, deg>0 anchors — is <= mdup.
+
+    Bitwise equality with merge_expand is only promised on the
+    distinct-anchor stream arm (no live found duplicate) and on the XLA
+    fallback (mmax > mdup); the m-hot arm is bag-order (edge-repeat).
+    Live-masking can trim a constructed m > mdup frontier back into m-hot
+    range — found by the round-5 fresh-seed soak at seed 7218."""
+    deg_of = dict(zip(keys.tolist(), np.asarray(degs).tolist()))
+    found_cnt: dict = {}  # live anchors on keys PRESENT in the segment
+    run_cnt: dict = {}  # live anchors on keys with deg > 0
+    for i in range(int(n)):
+        if live[i]:
+            a = int(cur[i])
+            if a in deg_of:
+                found_cnt[a] = found_cnt.get(a, 0) + 1
+                if deg_of[a] > 0:
+                    run_cnt[a] = run_cnt.get(a, 0) + 1
+    dup = max(found_cnt.values(), default=0) >= 2
+    mmax = max(run_cnt.values(), default=0)
+    return (not dup) or mmax > mdup
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_adversarial_mix_fuzz(seed):
+    """Randomized adversarial mixes (everything at once); the bitwise-vs-
+    bag expectation mirrors the kernel's actual arm dispatch."""
+    c = _mix_case(seed)
+    _check(c["sk"], c["ss"], c["sd"], c["e"], c["cur"], c["n"], c["live"],
+           c["cap"], mdup=c["mdup"], mxu=c["mxu"],
+           expect_bitwise=_expect_bitwise(
+               c["keys"], c["degs"], c["cur"], c["n"], c["live"],
+               c["mdup"]))
+
+
+def test_live_masked_multiplicity_takes_mhot_arm():
+    """Soak regression (seed 7218): anchors constructed at multiplicity 3
+    with mdup=2, but live-masking leaves max TWO live copies per key — the
+    kernel takes the m-hot arm (bag semantics), and the old assumption
+    that constructed m > mdup implies the bitwise XLA fallback is wrong.
+    Overflow additionally makes the two arms truncate different prefixes,
+    which only the totals contract covers."""
+    c = _mix_case(218)
+    assert c["m"] > c["mdup"]  # the trap: constructed mult says fallback..
+    bw = _expect_bitwise(c["keys"], c["degs"], c["cur"], c["n"], c["live"],
+                         c["mdup"])
+    assert not bw  # ...but the effective live multiplicity says m-hot
+    total, k = _check(c["sk"], c["ss"], c["sd"], c["e"], c["cur"], c["n"],
+                      c["live"], c["cap"], mdup=c["mdup"], mxu=c["mxu"],
+                      expect_bitwise=bw)
+    assert total > c["cap"]  # the overflow half of the scenario is real
