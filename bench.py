@@ -386,6 +386,9 @@ def _drop_partial(scale: int, qn: str, backend: str,
         return bool(d.get("provisional")) or d.get("batch", 0) > above_batch
 
     try:
+        # mirror _record_partial: in a fresh cache dir the lock file's
+        # parent may not exist yet (ADVICE.md round-5 #4)
+        os.makedirs(CACHE, exist_ok=True)
         with open(PARTIAL_PATH + ".lock", "w") as lk:
             fcntl.flock(lk, fcntl.LOCK_EX)
             store = _load_partial()

@@ -21,6 +21,12 @@ export WUKONG_CACHE_DIR="$REPO/.cache"
 export WUKONG_PROBE_TIMEOUT=90
 cd "$SNAP" || exit 1
 PASS=0
+# Reset the persisted ladder rung at loop startup (ADVICE.md round-5 #2):
+# the rung only ever escalates within a session, so a stale top-rung file
+# from a healthy round would send a later degraded-relay session straight
+# to LUBM-2560 — the exact failure mode the ladder exists to prevent. Each
+# session re-proves the lower rungs first (they are cheap when healthy).
+rm -f "$RUNG_FILE"
 banked_at() {  # TPU-partial evidence at scale $1
   # mode (arg 2): "any" counts :tpu: keys; "default" counts only entries
   # measured under default kernel toggles (the helper runs OUTSIDE
@@ -88,8 +94,12 @@ sys.exit(0 if jax.devices()[0].platform != 'cpu' else 1)" >/dev/null 2>&1; then
     # on-chip proof for this pass: the final headline labels backend tpu
     # only when every surviving query has on-chip evidence passing the
     # 24h freshness filter (prior-ROUND history can't fake it)
+    # grep the WHOLE pass log, not tail -1: stdout and stderr are merged,
+    # and any stderr after the headline JSON (JAX shutdown warnings, atexit
+    # messages) would hide the backend line from a last-line check and
+    # silently suppress the fully-green escalation path (ADVICE.md r5 #3)
     ONCHIP=0
-    [ "$rc" -eq 0 ] && tail -1 "$PASS_LOG" | grep -q '"backend": *"tpu"' && ONCHIP=1
+    [ "$rc" -eq 0 ] && grep -q '^{.*"backend": *"tpu"' "$PASS_LOG" && ONCHIP=1
     rm -f "$PASS_LOG"
     echo "[$(date +%F' '%T)] bench pass done (rc=$rc, sig $BEFORE->$AFTER, onchip=$ONCHIP at $SCALE)" >> "$LOG"
     # escalate when THIS pass changed the scale's on-chip evidence (new

@@ -1,0 +1,654 @@
+"""Chaos suite: deterministic fault injection over the resilience layer.
+
+Every test runs off a seeded :class:`FaultPlan` (runtime/faults.py) or an
+injected fake clock, so the "chaos" here is exactly replayable — same seed,
+same failure schedule — and the suite is as deterministic as any other
+module. Covers the Deadline/budget machinery, retry with backoff + jitter,
+the circuit-breaker state machine, graceful degradation to partial results
+(engine- and dist-level), and the engine pool's load-shedding path.
+"""
+
+import random
+
+import pytest
+
+from wukong_tpu.config import Global
+from wukong_tpu.engine.cpu import CPUEngine
+from wukong_tpu.loader.lubm import VirtualLubmStrings, generate_lubm
+from wukong_tpu.planner.heuristic import heuristic_plan
+from wukong_tpu.runtime import faults
+from wukong_tpu.runtime.faults import (
+    FaultPlan,
+    FaultSpec,
+    TransientFault,
+    parse_plan,
+)
+from wukong_tpu.runtime.resilience import CircuitBreaker, Deadline, retry_call
+from wukong_tpu.runtime.scheduler import EnginePool
+from wukong_tpu.sparql.parser import Parser
+from wukong_tpu.store.gstore import build_partition
+from wukong_tpu.utils.errors import (
+    BudgetExceeded,
+    ErrorCode,
+    QueryTimeout,
+    RetryExhausted,
+    ShardUnavailable,
+    WukongError,
+)
+
+pytestmark = pytest.mark.chaos
+
+# Inline queries (no dependency on the reference checkout): a 2-hop chain
+# whose step-0 index scan seeds thousands of rows, and a const-anchored
+# lookup — both inside the distributed engine's BGP support matrix.
+Q2HOP = """
+PREFIX ub: <http://swat.cse.lehigh.edu/onto/univ-bench.owl#>
+SELECT ?X ?Y ?Z WHERE {
+    ?X ub:memberOf ?Y .
+    ?Y ub:subOrganizationOf ?Z .
+}
+"""
+QDEPT = """
+PREFIX rdf: <http://www.w3.org/1999/02/22-rdf-syntax-ns#>
+PREFIX ub: <http://swat.cse.lehigh.edu/onto/univ-bench.owl#>
+SELECT ?X WHERE {
+    ?X ub:worksFor <http://www.Department0.University0.edu> .
+    ?X rdf:type ub:FullProfessor .
+}
+"""
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+class FakeClock:
+    """Injectable monotonic clock; sleep() advances it (no real waiting)."""
+
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def sleep(self, s: float) -> None:
+        self.t += s
+
+
+class SteppingClock:
+    """Advances by a fixed step on every read — expires a Deadline after a
+    known number of checks without real time passing."""
+
+    def __init__(self, step: float):
+        self.t = 0.0
+        self.step = step
+
+    def __call__(self) -> float:
+        t = self.t
+        self.t += self.step
+        return t
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan determinism
+# ---------------------------------------------------------------------------
+
+def _run_schedule(seed: int, rounds: int = 40) -> list:
+    plan = FaultPlan([FaultSpec("a", "transient", p=0.5),
+                      FaultSpec("b", "transient", p=0.5)], seed=seed)
+    outcomes = []
+    for i in range(rounds):
+        for site in ("a", "b"):
+            try:
+                plan.fire(site)
+                outcomes.append((site, "ok"))
+            except TransientFault:
+                outcomes.append((site, "fault"))
+    return outcomes
+
+
+def test_same_seed_same_schedule():
+    assert _run_schedule(seed=42) == _run_schedule(seed=42)
+
+
+def test_different_seed_different_schedule():
+    assert _run_schedule(seed=42) != _run_schedule(seed=43)
+
+
+def test_sites_draw_independent_streams():
+    # site b's decisions must not depend on whether site a was called at
+    # all — each spec has its own RNG stream derived from (seed, site, idx)
+    specs = lambda: [FaultSpec("a", "transient", p=0.5),  # noqa: E731
+                     FaultSpec("b", "transient", p=0.5)]
+    interleaved = FaultPlan(specs(), seed=7)
+    b_only = FaultPlan(specs(), seed=7)
+
+    def draw(plan, site):
+        try:
+            plan.fire(site)
+            return "ok"
+        except TransientFault:
+            return "fault"
+
+    got_interleaved = []
+    got_b_only = []
+    for _ in range(30):
+        draw(interleaved, "a")
+        got_interleaved.append(draw(interleaved, "b"))
+        got_b_only.append(draw(b_only, "b"))
+    assert got_interleaved == got_b_only
+
+
+def test_spec_count_after_and_shard_filters():
+    plan = FaultPlan([FaultSpec("s", "transient", count=2, after=1, shard=3)],
+                     seed=0)
+    # wrong shard never fires
+    plan.fire("s", shard=1)
+    # first matching call skipped (after=1), next two fire, then exhausted
+    plan.fire("s", shard=3)
+    for _ in range(2):
+        with pytest.raises(TransientFault):
+            plan.fire("s", shard=3)
+    plan.fire("s", shard=3)  # count spent: no-op again
+    assert [k for (_, _, k) in plan.history] == ["transient", "transient"]
+
+
+def test_delay_kind_sleeps():
+    clock = FakeClock()
+    plan = FaultPlan([FaultSpec("s", "delay", delay_s=0.25)], seed=0,
+                     sleep=clock.sleep)
+    plan.fire("s")
+    assert clock.t == pytest.approx(0.25)
+
+
+def test_parse_plan_env_form():
+    plan = parse_plan("seed=42; dist.shard_fetch:transient,p=0.3,count=2; "
+                      "hdfs.read:delay,delay=0.05; pool.execute:shard_down,"
+                      "shard=1,after=4")
+    assert plan.seed == 42
+    a, b, c = plan.specs
+    assert (a.site, a.kind, a.p, a.count) == ("dist.shard_fetch",
+                                              "transient", 0.3, 2)
+    assert (b.site, b.kind, b.delay_s) == ("hdfs.read", "delay", 0.05)
+    assert (c.site, c.kind, c.shard, c.after) == ("pool.execute",
+                                                  "shard_down", 1, 4)
+    with pytest.raises(ValueError):
+        parse_plan("x:transient,bogus=1")
+    with pytest.raises(ValueError):  # bad kind is a parse-time config error
+        parse_plan("hdfs.read:delay=0.05")
+
+
+def test_env_var_installs_plan(monkeypatch):
+    monkeypatch.setenv("WUKONG_FAULT_PLAN", "seed=9;hdfs.read:transient")
+    monkeypatch.setitem(faults._state, "plan", None)
+    monkeypatch.setitem(faults._state, "env_checked", False)
+    plan = faults.active()
+    assert plan is not None and plan.seed == 9
+    faults.clear()
+    assert faults.active() is None  # explicit clear overrides the env var
+
+
+# ---------------------------------------------------------------------------
+# retry with exponential backoff + jitter
+# ---------------------------------------------------------------------------
+
+def test_retry_recovers_after_transients():
+    calls = {"n": 0}
+
+    def fn():
+        calls["n"] += 1
+        if calls["n"] <= 2:
+            raise TransientFault("boom")
+        return "ok"
+
+    sleeps = []
+    out = retry_call(fn, attempts=3, base_ms=10, max_ms=2000,
+                     rng=random.Random(0), sleep=sleeps.append)
+    assert out == "ok" and calls["n"] == 3
+    # equal jitter: delay_i is uniform in [window/2, window] with
+    # window = base * 2^i
+    assert len(sleeps) == 2
+    assert 0.005 <= sleeps[0] <= 0.010
+    assert 0.010 <= sleeps[1] <= 0.020
+
+
+def test_retry_backoff_is_capped():
+    sleeps = []
+
+    def fn():
+        raise TransientFault("always")
+
+    with pytest.raises(RetryExhausted):
+        retry_call(fn, attempts=6, base_ms=10, max_ms=40,
+                   rng=random.Random(0), sleep=sleeps.append)
+    assert len(sleeps) == 5
+    assert all(s <= 0.040 for s in sleeps)
+
+
+def test_retry_exhausted_carries_last_exception():
+    def fn():
+        raise TransientFault("persistent")
+
+    with pytest.raises(RetryExhausted) as ei:
+        retry_call(fn, attempts=2, base_ms=1, sleep=lambda s: None)
+    assert ei.value.code == ErrorCode.RETRY_EXHAUSTED
+    assert isinstance(ei.value.last, TransientFault)
+
+
+def test_retry_nonretryable_propagates_immediately():
+    calls = {"n": 0}
+
+    def fn():
+        calls["n"] += 1
+        raise ValueError("not transient")
+
+    with pytest.raises(ValueError):
+        retry_call(fn, attempts=5, base_ms=1, sleep=lambda s: None)
+    assert calls["n"] == 1
+
+
+def test_retry_respects_deadline_in_backoff():
+    clock = FakeClock()
+    dl = Deadline(timeout_ms=8, clock=clock)  # 8 ms left, first delay >= 5 ms
+
+    def fn():
+        raise TransientFault("boom")
+
+    with pytest.raises(QueryTimeout):
+        retry_call(fn, attempts=5, base_ms=20, max_ms=2000,
+                   rng=random.Random(0), sleep=clock.sleep, deadline=dl)
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker state machine
+# ---------------------------------------------------------------------------
+
+def test_breaker_trips_then_half_opens():
+    clock = FakeClock()
+    b = CircuitBreaker(threshold=3, cooldown_ms=1000, clock=clock)
+    assert b.state("s") == "closed" and b.allow("s")
+    for _ in range(3):
+        b.record_failure("s")
+    assert b.state("s") == "open" and b.tripped("s")
+    assert not b.allow("s")  # open: calls short-circuit
+    clock.t += 1.0
+    assert b.state("s") == "half_open"
+    assert b.allow("s")       # exactly one half-open trial admitted
+    assert not b.allow("s")   # concurrent caller blocked during the trial
+    b.record_success("s")
+    assert b.state("s") == "closed" and b.allow("s")
+
+
+def test_breaker_failed_trial_reopens():
+    clock = FakeClock()
+    b = CircuitBreaker(threshold=2, cooldown_ms=1000, clock=clock)
+    b.record_failure("s")
+    b.record_failure("s")
+    clock.t += 1.0
+    assert b.allow("s")      # half-open trial
+    b.record_failure("s")    # trial fails
+    assert b.state("s") == "open"
+    assert not b.allow("s")  # a fresh cooldown must elapse
+    clock.t += 1.0
+    assert b.allow("s")
+
+
+def test_breaker_keys_are_independent():
+    b = CircuitBreaker(threshold=1, cooldown_ms=1000, clock=FakeClock())
+    b.record_failure(0)
+    assert b.tripped(0) and not b.tripped(1)
+    assert b.tripped_keys() == [0]
+
+
+def test_breaker_half_open_trial_settles_on_unexpected_error():
+    clock = FakeClock()
+    b = CircuitBreaker(threshold=1, cooldown_ms=1000, clock=clock)
+    b.record_failure("s")
+    clock.t += 1.0
+
+    def fn():
+        raise RuntimeError("not a transient")
+
+    with pytest.raises(RuntimeError):
+        retry_call(fn, breaker=b, key="s", sleep=lambda s: None)
+    # the failed trial reopened the breaker instead of wedging half-open
+    # with the trial slot held forever
+    assert b.state("s") == "open"
+    clock.t += 1.0
+    assert b.allow("s")  # a later cooldown admits a fresh trial
+
+
+def test_breaker_aborted_trial_releases_slot():
+    clock = FakeClock()
+    b = CircuitBreaker(threshold=1, cooldown_ms=1000, clock=clock)
+    dl = Deadline(timeout_ms=100, clock=clock)
+    b.record_failure("s")
+    clock.t += 1.0  # past the cooldown AND past the deadline
+
+    with pytest.raises(QueryTimeout):
+        retry_call(lambda: "ok", breaker=b, key="s", deadline=dl,
+                   sleep=lambda s: None)
+    assert b.allow("s")  # the admitted trial slot was released, not wedged
+
+
+def test_retry_call_short_circuits_on_open_breaker():
+    b = CircuitBreaker(threshold=1, cooldown_ms=1000, clock=FakeClock())
+    b.record_failure(3)
+    calls = {"n": 0}
+
+    def fn():
+        calls["n"] += 1
+
+    with pytest.raises(ShardUnavailable) as ei:
+        retry_call(fn, breaker=b, key=3, sleep=lambda s: None)
+    assert calls["n"] == 0 and ei.value.shard == 3
+
+
+# ---------------------------------------------------------------------------
+# Deadline / budget
+# ---------------------------------------------------------------------------
+
+def test_deadline_expiry_and_budget():
+    clock = FakeClock()
+    dl = Deadline(timeout_ms=100, clock=clock)
+    dl.check("t0")  # fine
+    clock.t += 0.2
+    assert dl.expired()
+    with pytest.raises(QueryTimeout) as ei:
+        dl.check("step 3")
+    assert ei.value.code == ErrorCode.QUERY_TIMEOUT
+
+    budget = Deadline(timeout_ms=0, budget_rows=10, clock=clock)
+    budget.charge_rows(6)
+    with pytest.raises(BudgetExceeded):
+        budget.charge_rows(5, "step 1")
+    assert not budget.expired()  # no wall-clock limit configured
+
+
+def test_deadline_from_config(monkeypatch):
+    monkeypatch.setattr(Global, "query_deadline_ms", 0)
+    monkeypatch.setattr(Global, "query_budget_rows", 0)
+    assert Deadline.from_config() is None
+    monkeypatch.setattr(Global, "query_budget_rows", 500)
+    dl = Deadline.from_config()
+    assert dl is not None and dl.budget_rows == 500
+
+
+# ---------------------------------------------------------------------------
+# engine-level graceful degradation (LUBM-1, single partition)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def cpu_world():
+    triples, _ = generate_lubm(1, seed=42)
+    g = build_partition(triples, 0, 1)
+    ss = VirtualLubmStrings(1, seed=42)
+    return g, ss, CPUEngine(g, ss)
+
+
+def _parse(ss, text):
+    q = Parser(ss).parse(text)
+    heuristic_plan(q)
+    return q
+
+
+def test_cpu_deadline_yields_partial_result(cpu_world):
+    _, ss, cpu = cpu_world
+    q = _parse(ss, Q2HOP)
+    # 50 ms deadline on a clock stepping 30 ms per read: the step-0 check
+    # passes, the step-1 check raises — exactly one pattern executes
+    q.deadline = Deadline(timeout_ms=50, clock=SteppingClock(0.03))
+    cpu.execute(q)  # must not raise: degradation, not a crash
+    assert q.result.status_code == ErrorCode.QUERY_TIMEOUT
+    assert q.result.complete is False
+    assert q.result.dropped_patterns  # the unexecuted tail is reported
+    assert q.result.nrows > 0  # rows produced before expiry are kept
+
+
+def test_cpu_budget_yields_partial_result(cpu_world):
+    _, ss, cpu = cpu_world
+    q = _parse(ss, Q2HOP)
+    q.deadline = Deadline(budget_rows=1)
+    cpu.execute(q)
+    assert q.result.status_code == ErrorCode.BUDGET_EXCEEDED
+    assert q.result.complete is False
+    assert q.result.nrows > 0
+
+
+def test_partial_results_can_be_disabled(cpu_world, monkeypatch):
+    _, ss, cpu = cpu_world
+    monkeypatch.setattr(Global, "enable_partial_results", False)
+    q = _parse(ss, Q2HOP)
+    q.deadline = Deadline(budget_rows=1)
+    cpu.execute(q)
+    assert q.result.status_code == ErrorCode.BUDGET_EXCEEDED
+    assert q.result.complete is False
+    assert q.result.nrows == 0  # partial rows discarded by the knob
+
+
+def test_no_deadline_is_zero_overhead_path(cpu_world):
+    # the default (no resilience knobs set) must stay exactly as before:
+    # complete result, SUCCESS status, no deadline attached
+    _, ss, cpu = cpu_world
+    q = _parse(ss, Q2HOP)
+    assert q.deadline is None
+    cpu.execute(q)
+    assert q.result.status_code == ErrorCode.SUCCESS
+    assert q.result.complete is True
+    assert q.result.dropped_patterns == []
+
+
+def test_proxy_degrades_capacity_exceeded_to_cpu(cpu_world):
+    # the device capacity ceiling is a TPU constraint, not a query
+    # property: the proxy must transparently re-run host-side
+    from wukong_tpu.runtime.proxy import Proxy
+
+    g, ss, cpu = cpu_world
+
+    class CapacityBoundTPU:
+        def execute(self, q, from_proxy=True):
+            q.result.status_code = ErrorCode.CAPACITY_EXCEEDED
+            return q
+
+    proxy = Proxy(g, ss, cpu, CapacityBoundTPU())
+    q = proxy.run_single_query(QDEPT, device="tpu", blind=False)
+    assert q.result.status_code == ErrorCode.SUCCESS
+    assert q.result.nrows > 0
+
+
+# ---------------------------------------------------------------------------
+# engine pool load shedding (no wedging)
+# ---------------------------------------------------------------------------
+
+def test_pool_sheds_expired_queries_and_keeps_serving():
+    class Echo:
+        def execute(self, q):
+            return ("served", q)
+
+    pool = EnginePool(num_engines=2, make_engine=lambda tid: Echo())
+    pool.start()
+    try:
+        clock = FakeClock()
+        expired = type("Q", (), {})()
+        expired.deadline = Deadline(timeout_ms=10, clock=clock)
+        clock.t = 1.0  # deadline long gone before the pool pops it
+        out = pool.wait(pool.submit(expired), timeout=10)
+        assert isinstance(out, QueryTimeout)  # structured, not a crash
+        # the pool is not wedged: a healthy query still gets served
+        healthy = type("Q", (), {})()
+        out2 = pool.wait(pool.submit(healthy), timeout=10)
+        assert out2 == ("served", healthy)
+    finally:
+        pool.stop()
+
+
+def test_pool_fault_site_injects_per_engine(monkeypatch):
+    # pool.execute faults (keyed by engine tid via the shard field) become
+    # the query's reply — the engine thread itself survives
+    class Echo:
+        def execute(self, q):
+            return "served"
+
+    faults.install(FaultPlan([FaultSpec("pool.execute", "transient",
+                                        count=1)], seed=0))
+    pool = EnginePool(num_engines=1, make_engine=lambda tid: Echo())
+    pool.start()
+    try:
+        q1 = type("Q", (), {})()
+        out = pool.wait(pool.submit(q1), timeout=10)
+        assert isinstance(out, TransientFault)
+        out2 = pool.wait(pool.submit(q1), timeout=10)  # count spent
+        assert out2 == "served"
+    finally:
+        pool.stop()
+
+
+# ---------------------------------------------------------------------------
+# HDFS reads through the retry layer
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def _fake_hdfs(monkeypatch):
+    from wukong_tpu.loader import hdfs
+
+    monkeypatch.setenv("WUKONG_HDFS_CMD", "true")  # exits 0, ignores args
+    monkeypatch.setitem(hdfs._state, "probed", False)
+    monkeypatch.setitem(hdfs._state, "cmd", None)
+    monkeypatch.setattr(Global, "retry_base_ms", 1)
+    monkeypatch.setattr(Global, "retry_max_ms", 2)
+    return hdfs
+
+
+def test_hdfs_read_retries_through_transients(_fake_hdfs):
+    faults.install(FaultPlan([FaultSpec("hdfs.read", "transient", count=2)],
+                             seed=0))
+    assert _fake_hdfs._run(["-ls", "/x"]) == ""  # 3rd attempt succeeds
+
+
+def test_hdfs_read_exhaustion_surfaces_clean_error(_fake_hdfs):
+    faults.install(FaultPlan([FaultSpec("hdfs.read", "transient")], seed=0))
+    with pytest.raises(WukongError) as ei:
+        _fake_hdfs._run(["-ls", "/x"])
+    assert ei.value.code == ErrorCode.FILE_NOT_FOUND
+
+
+# ---------------------------------------------------------------------------
+# distributed engine: persistent shard-down -> flagged partial result
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def dist_world(eight_cpu_devices):
+    from wukong_tpu.parallel.mesh import make_mesh
+    from wukong_tpu.store.gstore import build_all_partitions
+
+    triples, _ = generate_lubm(1, seed=42)
+    ss = VirtualLubmStrings(1, seed=42)
+    stores = build_all_partitions(triples, 8)
+    mesh = make_mesh(8)
+    return ss, stores, mesh
+
+
+@pytest.fixture(autouse=True)
+def _pin_collective_route(monkeypatch):
+    # force the sharded route so shard fetches actually happen at LUBM-1
+    monkeypatch.setattr(Global, "enable_dist_inplace", False)
+
+
+def _dist_run_with_shard_down(dist_world, seed):
+    from wukong_tpu.parallel.dist_engine import DistEngine
+
+    ss, stores, mesh = dist_world
+    plan = FaultPlan([FaultSpec("dist.shard_fetch", "shard_down", shard=1)],
+                     seed=seed)
+    faults.install(plan)
+    dist = DistEngine(stores, ss, mesh)
+    q = _parse(ss, Q2HOP)
+    dist.execute(q)  # must not raise
+    return q, plan
+
+
+def test_shard_down_yields_flagged_partial_result(dist_world):
+    q, plan = _dist_run_with_shard_down(dist_world, seed=7)
+    assert q.result.status_code == ErrorCode.SUCCESS  # well-formed reply
+    assert q.result.complete is False  # ... but flagged incomplete
+    assert "shard:1" in q.result.dropped_patterns
+    assert plan.history  # the fault actually fired
+    assert all(site == "dist.shard_fetch" and shard == 1
+               for (site, shard, _) in plan.history)
+
+
+def test_shard_down_schedule_replays_identically(dist_world):
+    q1, p1 = _dist_run_with_shard_down(dist_world, seed=7)
+    q2, p2 = _dist_run_with_shard_down(dist_world, seed=7)
+    assert p1.history == p2.history  # identical seed, identical schedule
+    assert q1.result.nrows == q2.result.nrows
+    assert q1.result.dropped_patterns == q2.result.dropped_patterns
+
+
+def test_dist_results_complete_without_faults(dist_world):
+    from wukong_tpu.parallel.dist_engine import DistEngine
+
+    ss, stores, mesh = dist_world
+    dist = DistEngine(stores, ss, mesh)
+    q = _parse(ss, Q2HOP)
+    dist.execute(q)
+    assert q.result.status_code == ErrorCode.SUCCESS
+    assert q.result.complete is True
+    assert q.result.dropped_patterns == []
+
+
+def test_shard_transients_are_retried_transparently(dist_world, monkeypatch):
+    from wukong_tpu.parallel.dist_engine import DistEngine
+
+    monkeypatch.setattr(Global, "retry_base_ms", 1)
+    monkeypatch.setattr(Global, "retry_max_ms", 2)
+    ss, stores, mesh = dist_world
+    # one transient on shard 2's first fetch: the retry absorbs it and the
+    # result is complete — clients never see the hiccup
+    faults.install(FaultPlan([FaultSpec("dist.shard_fetch", "transient",
+                                        shard=2, count=1)], seed=0))
+    dist = DistEngine(stores, ss, mesh)
+    q = _parse(ss, Q2HOP)
+    dist.execute(q)
+    assert q.result.status_code == ErrorCode.SUCCESS
+    assert q.result.complete is True
+
+
+def test_shard_recovery_restores_complete_results(dist_world, monkeypatch):
+    from wukong_tpu.parallel.dist_engine import DistEngine
+
+    # cooldown 0: the breaker half-opens immediately once the fault clears
+    monkeypatch.setattr(Global, "breaker_cooldown_ms", 0)
+    ss, stores, mesh = dist_world
+    faults.install(FaultPlan([FaultSpec("dist.shard_fetch", "shard_down",
+                                        shard=1)], seed=0))
+    dist = DistEngine(stores, ss, mesh)
+    q = _parse(ss, Q2HOP)
+    dist.execute(q)
+    assert q.result.complete is False
+    faults.clear()  # shard comes back
+    # degraded stagings were never cached, so the next query re-fetches;
+    # the stale outage must NOT keep flagging healthy replies incomplete
+    q2 = _parse(ss, Q2HOP)
+    dist.execute(q2)
+    assert q2.result.status_code == ErrorCode.SUCCESS
+    assert q2.result.complete is True
+    assert q2.result.dropped_patterns == []
+
+
+def test_breaker_opens_after_repeated_shard_down(dist_world):
+    from wukong_tpu.parallel.dist_engine import DistEngine
+
+    ss, stores, mesh = dist_world
+    faults.install(FaultPlan([FaultSpec("dist.shard_fetch", "shard_down",
+                                        shard=1)], seed=0))
+    dist = DistEngine(stores, ss, mesh)
+    for text in (Q2HOP, QDEPT):
+        q = _parse(ss, text)
+        dist.execute(q)
+        assert q.result.complete is False
+    assert dist.sstore.breaker.tripped(1)  # persistent faults trip it
+    assert 1 in dist.sstore.degraded_shards

@@ -117,6 +117,23 @@ def test_gsck_detects_corruption(lubm1):
     assert any("missing from tidx" in e for e in check_partition(g))
 
 
+def test_vid_range_rejects_out_of_range_ids():
+    from wukong_tpu.store.gstore import check_vid_range
+    from wukong_tpu.utils.errors import WukongError
+
+    check_vid_range(np.empty((0, 3), dtype=np.int64))  # empty: fine
+    ok = np.array([[1, 2, 3]], dtype=np.int64)
+    check_vid_range(ok)
+    # >= 2^31 - 1 collides with the int32 device padding sentinel
+    with pytest.raises(WukongError):
+        check_vid_range(np.array([[1, 2, 2**31 - 1]], dtype=np.int64))
+    # negative ids violate the native radix sort's unsigned-digit contract
+    # (the np.lexsort fallback would order them correctly — a silent
+    # toolchain-dependent store divergence unless rejected here)
+    with pytest.raises(WukongError):
+        check_vid_range(np.array([[1, 2, -5]], dtype=np.int64))
+
+
 def test_string_server_virtual(tmp_path, lubm1):
     write_dataset(str(tmp_path), 1, seed=42, fmt="npy")
     ss = StringServer(str(tmp_path))

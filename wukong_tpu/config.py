@@ -61,6 +61,28 @@ class GlobalConfig:
     # Pallas streaming merge-expand for dense heavy expansions (tpu_stream)
     enable_stream_expand: bool = True
 
+    # ---- resilience knobs (runtime/resilience.py; all mutable) ----
+    # per-query wall-clock deadline in ms; 0 disables. Checked at every BGP
+    # step / chain attempt; expiry raises a structured QueryTimeout and the
+    # reply carries a partial result (result.complete = False).
+    query_deadline_ms: int = 0
+    # per-query intermediate-row work budget; 0 disables. Every BGP step
+    # charges its output rows; overrun raises BudgetExceeded. This is the
+    # blowup guard GPU-side Datalog engines use instead of OOMing.
+    query_budget_rows: int = 0
+    # on deadline/budget expiry keep the rows produced so far and tag the
+    # reply incomplete instead of clearing the table
+    enable_partial_results: bool = True
+    # transient-failure retry (shard fetch, HDFS reads, chain dispatch):
+    # attempts, exponential-backoff base, and backoff ceiling
+    retry_max_attempts: int = 3
+    retry_base_ms: int = 10
+    retry_max_ms: int = 2000
+    # per-shard circuit breaker: consecutive failures before the breaker
+    # opens, and how long it stays open before a half-open trial
+    breaker_threshold: int = 3
+    breaker_cooldown_ms: int = 5000
+
     # ---- TPU-engine knobs (new; no reference analogue) ----
     table_capacity_min: int = 1024  # smallest binding-table capacity class
     # largest capacity class: 32M rows x 8 cols x int32 = 1 GiB, within one

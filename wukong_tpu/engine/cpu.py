@@ -40,7 +40,13 @@ from wukong_tpu.types import (
     AttrType,
     is_tpid,
 )
-from wukong_tpu.utils.errors import ErrorCode, WukongError, assert_ec
+from wukong_tpu.utils.errors import (
+    BudgetExceeded,
+    ErrorCode,
+    QueryTimeout,
+    WukongError,
+    assert_ec,
+)
 
 CONST_VAR, KNOWN_VAR, UNKNOWN_VAR = 0, 1, 2
 
@@ -117,6 +123,12 @@ class CPUEngine:
                 self._execute_filters(q)
             if from_proxy:
                 self._final_process(q)
+        except (QueryTimeout, BudgetExceeded) as e:
+            # graceful degradation: keep the rows produced so far, tag the
+            # reply incomplete with the dropped patterns (resilience layer)
+            from wukong_tpu.runtime.resilience import mark_partial
+
+            mark_partial(q, e)
         except WukongError as e:
             q.result.status_code = e.code
         return q
@@ -145,9 +157,13 @@ class CPUEngine:
 
     def _execute_patterns(self, q: SPARQLQuery) -> None:
         from wukong_tpu.config import Global
+        from wukong_tpu.runtime.resilience import charge_query, check_query
 
         while not q.done_patterns():
+            check_query(q, f"cpu.bgp step {q.pattern_step}")
             self._execute_one_pattern(q)
+            charge_query(q, q.result.nrows,
+                         f"cpu.bgp step {q.pattern_step - 1}")
             # co-run optimization at the marked step (sparql.hpp:1130-1131)
             if (q.corun_enabled and Global.enable_corun
                     and q.pattern_step == q.corun_step):
@@ -571,6 +587,7 @@ class CPUEngine:
             child.pqid = q.qid
             child.pg_type = PGType.UNION
             child.pattern_group = sub_pg
+            child.deadline = q.deadline  # children share the parent's budget
             child.result = copy.deepcopy(q.result)
             child.result.blind = False
             child.mt_factor = q.mt_factor if child.start_from_index() else 1
@@ -618,6 +635,7 @@ class CPUEngine:
         child = SPARQLQuery()
         child.pqid = q.qid
         child.pg_type = PGType.OPTIONAL
+        child.deadline = q.deadline  # children share the parent's budget
         child.pattern_group = copy.deepcopy(q.pattern_group.optional[q.optional_step])
         q.optional_step += 1
         self._count_optional_new_vars(child.pattern_group, q.result)

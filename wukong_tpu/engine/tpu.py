@@ -36,7 +36,14 @@ from wukong_tpu.engine.cpu import CPUEngine
 from wukong_tpu.engine.device_store import DeviceStore
 from wukong_tpu.sparql.ir import NO_RESULT, PGType, SPARQLQuery
 from wukong_tpu.types import IN, OUT, PREDICATE_ID, TYPE_ID, AttrType
-from wukong_tpu.utils.errors import ErrorCode, WukongError, assert_ec
+from wukong_tpu.utils.errors import (
+    BudgetExceeded,
+    CapacityExceeded,
+    ErrorCode,
+    QueryTimeout,
+    WukongError,
+    assert_ec,
+)
 
 CONST_VAR, KNOWN_VAR, UNKNOWN_VAR = 0, 1, 2
 
@@ -149,6 +156,10 @@ class TPUEngine:
                 self.cpu._execute_filters(q)
             if from_proxy:
                 self.cpu._final_process(q)
+        except (QueryTimeout, BudgetExceeded) as e:
+            from wukong_tpu.runtime.resilience import mark_partial
+
+            mark_partial(q, e)
         except WukongError as e:
             q.result.status_code = e.code
         return q
@@ -219,10 +230,13 @@ class TPUEngine:
                     and not q.pattern_group.unions
                     and not q.pattern_group.optional
                     and not q.pattern_group.filters)
+        from wukong_tpu.runtime.resilience import charge_query, check_query
+
         cap_override: dict[int, int] = {}
         step_est = (self._chain_estimates(q.pattern_group.patterns)
                     if q.pattern_step == 0 else {})
         for _attempt in range(8):
+            check_query(q, f"tpu.chain attempt {_attempt}")
             state = self._dispatch_chain(q, device_steps, cap_override,
                                          step_est)
             host_table, n, totals = state.sync(blind=blind_ok)
@@ -232,8 +246,10 @@ class TPUEngine:
             for s, t, c in totals:
                 if t > c:
                     if t > self.cap_max:
-                        raise WukongError(
-                            ErrorCode.UNKNOWN_PATTERN,
+                        # CapacityExceeded (not a query bug): the proxy
+                        # degrades to the CPU engine, which has no capacity
+                        # classes and can materialize the oversized table
+                        raise CapacityExceeded(
                             f"intermediate result ({t:,} rows) exceeds "
                             f"table_capacity_max ({self.cap_max:,})")
                     cap_override[s] = K.next_capacity(int(t), self.cap_min,
@@ -241,6 +257,7 @@ class TPUEngine:
         else:
             raise WukongError(ErrorCode.UNKNOWN_PATTERN,
                               "capacity retry limit exceeded")
+        charge_query(q, int(n), "tpu.chain")
         res = q.result
         if blind_ok:
             res.nrows = n

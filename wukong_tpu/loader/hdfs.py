@@ -45,22 +45,43 @@ def hdfs_available() -> bool:
 
 
 def _run(args: list[str]) -> str:
+    """One HDFS CLI invocation through the resilience layer: transient
+    failures (non-zero exit, client timeout, injected chaos at the
+    ``hdfs.read`` fault site) retry with exponential backoff + jitter; only
+    after the attempts are spent does the clean WukongError surface."""
+    from wukong_tpu.runtime import faults
+    from wukong_tpu.runtime.resilience import retry_call
+    from wukong_tpu.utils.errors import RetryExhausted
+
     cmd = _hdfs_cmd()
     if cmd is None:
         raise WukongError(
             ErrorCode.FILE_NOT_FOUND,
             "no HDFS client: install an `hdfs` CLI or set WUKONG_HDFS_CMD")
+
+    def attempt():
+        faults.site("hdfs.read")
+        return subprocess.run(
+            cmd + args, check=True, capture_output=True,
+            timeout=int(os.environ.get("WUKONG_HDFS_TIMEOUT", "600")))
+
     try:
-        r = subprocess.run(cmd + args, check=True, capture_output=True,
-                           timeout=int(os.environ.get("WUKONG_HDFS_TIMEOUT",
-                                                      "600")))
-    except subprocess.CalledProcessError as e:
-        raise WukongError(
-            ErrorCode.FILE_NOT_FOUND,
-            f"hdfs {' '.join(args)} failed: {e.stderr.decode()[-200:]}")
-    except subprocess.TimeoutExpired:
+        r = retry_call(attempt, site="hdfs.read",
+                       retry_on=(faults.TransientFault,
+                                 subprocess.CalledProcessError,
+                                 subprocess.TimeoutExpired, OSError))
+    except RetryExhausted as e:
+        last = e.last
+        if isinstance(last, subprocess.CalledProcessError):
+            raise WukongError(
+                ErrorCode.FILE_NOT_FOUND,
+                f"hdfs {' '.join(args)} failed: "
+                f"{last.stderr.decode()[-200:]}")
+        if isinstance(last, subprocess.TimeoutExpired):
+            raise WukongError(ErrorCode.FILE_NOT_FOUND,
+                              f"hdfs {' '.join(args)} timed out")
         raise WukongError(ErrorCode.FILE_NOT_FOUND,
-                          f"hdfs {' '.join(args)} timed out")
+                          f"hdfs {' '.join(args)} failed: {last!r}")
     return r.stdout.decode()
 
 

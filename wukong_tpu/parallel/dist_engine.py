@@ -30,7 +30,14 @@ from wukong_tpu.engine import tpu_kernels as K
 from wukong_tpu.parallel.sharded_store import ShardedDeviceStore
 from wukong_tpu.sparql.ir import NO_RESULT, PGType, SPARQLQuery
 from wukong_tpu.types import IN, OUT, PREDICATE_ID, TYPE_ID, AttrType
-from wukong_tpu.utils.errors import ErrorCode, WukongError, assert_ec
+from wukong_tpu.utils.errors import (
+    BudgetExceeded,
+    CapacityExceeded,
+    ErrorCode,
+    QueryTimeout,
+    WukongError,
+    assert_ec,
+)
 
 
 @dataclass
@@ -103,10 +110,29 @@ class DistEngine:
             self._fn_cache.clear()
             self._learned_caps.clear()
             self.__dict__.pop("_inplace_eng", None)
+        # degraded stagings are never cached, so a query served entirely
+        # from cache is complete by construction — judge incompleteness only
+        # by fetch failures during THIS query, not a prior query's outage
+        self.sstore.degraded_shards.clear()
         try:
             self._execute_sm(q, from_proxy)
+        except (QueryTimeout, BudgetExceeded) as e:
+            from wukong_tpu.runtime.resilience import mark_partial
+
+            mark_partial(q, e)
         except WukongError as e:
             q.result.status_code = e.code
+        if self.sstore.degraded_shards and q.result.status_code in (
+                ErrorCode.SUCCESS, ErrorCode.QUERY_TIMEOUT,
+                ErrorCode.BUDGET_EXCEEDED):
+            # a down shard's partition contributed nothing to this chain:
+            # the reply is well-formed but incomplete — tag it so clients
+            # can distinguish "empty" from "missing a shard" (no crash)
+            q.result.complete = False
+            for s in sorted(self.sstore.degraded_shards):
+                tag = f"shard:{s}"
+                if tag not in q.result.dropped_patterns:
+                    q.result.dropped_patterns.append(tag)
         return q
 
     def _execute_sm(self, q: SPARQLQuery, from_proxy: bool) -> None:
@@ -243,9 +269,14 @@ class DistEngine:
         snap_step = q.pattern_step
         snap_res = copy.deepcopy(q.result)
         target = q.pattern_step + n_steps
+        from wukong_tpu.runtime.resilience import charge_query, check_query
+
         try:
             while q.pattern_step < target:
+                check_query(q, f"dist.inplace step {q.pattern_step}")
                 eng._execute_one_pattern(q)
+                charge_query(q, q.result.nrows,
+                             f"dist.inplace step {q.pattern_step - 1}")
                 if q.result.nrows > thr:
                     raise InplaceOverflow()
         except InplaceOverflow:
@@ -306,6 +337,7 @@ class DistEngine:
             child.pqid = q.qid
             child.pg_type = PGType.UNION
             child.pattern_group = sub_pg
+            child.deadline = q.deadline  # children share the parent's budget
             # children rebind result state rather than mutate it, so the
             # parent table is shared by reference (no deepcopy of rows)
             child.result = Result(q.result.nvars)
@@ -391,11 +423,29 @@ class DistEngine:
         if self.force_cap_override:
             cap_override.update(self.force_cap_override)
         self.force_cap_override = None
+        from wukong_tpu.runtime import faults
+        from wukong_tpu.runtime.resilience import (
+            charge_query,
+            check_query,
+            retry_call,
+        )
+
         seed_cache: dict = {}  # seed shards are retry-invariant; transfer once
         for _attempt in range(8):
+            check_query(q, f"dist.chain attempt {_attempt}")
             plan = self._build_plan(q, cap_override, n_steps, seed)
             fn, args = self._get_fn(plan, seed, seed_cache)
-            out = fn(*args)
+
+            def _dispatch():
+                # transient dispatch failures (device hiccup, injected
+                # chaos) retry with backoff; inputs are immutable so a
+                # re-dispatch is safe
+                faults.site("dist.chain_dispatch")
+                return fn(*args)
+
+            out = retry_call(_dispatch, site="dist.chain_dispatch",
+                             retry_on=(faults.TransientFault,),
+                             deadline=getattr(q, "deadline", None))
 
             if q.result.blind:
                 ns, totals = _gather_host((out["n"], out["totals"]))
@@ -410,8 +460,7 @@ class DistEngine:
                 t = int(totals[:, i].max())
                 if t > s.cap:
                     if t > self.cap_max:
-                        raise WukongError(
-                            ErrorCode.UNKNOWN_PATTERN,
+                        raise CapacityExceeded(
                             f"intermediate result ({t:,} rows/shard) exceeds "
                             f"table_capacity_max ({self.cap_max:,})")
                     cap_override[("cap", i)] = K.next_capacity(
@@ -421,8 +470,7 @@ class DistEngine:
                     em = int(totals[:, S + i].max())
                     if em > s.exch_cap:
                         if em > self.cap_max:
-                            raise WukongError(
-                                ErrorCode.UNKNOWN_PATTERN,
+                            raise CapacityExceeded(
                                 f"exchange destination load ({em:,} rows) "
                                 f"exceeds table_capacity_max ({self.cap_max:,})")
                         cap_override[("exch", i)] = K.next_capacity(
@@ -471,10 +519,11 @@ class DistEngine:
             # (the retry would self-correct, but at a recompile per flip)
             self._learned_caps[pats_key] = learned
 
+        n_total = int(np.sum(ns))
+        charge_query(q, n_total, "dist.chain")
         res = q.result
         res.v2c_map = dict(plan.v2c)
         res.col_num = plan.width
-        n_total = int(np.sum(ns))
         if q.result.blind:
             res.nrows = n_total
         else:
@@ -861,8 +910,12 @@ class DistEngine:
     def _compile(self, plan: _Plan, args_template):
         import jax
         import jax.numpy as jnp
-        from jax import shard_map
         from jax.sharding import PartitionSpec as P
+
+        try:
+            from jax import shard_map
+        except ImportError:  # pre-0.5 JAX exposes it under experimental
+            from jax.experimental.shard_map import shard_map
 
         D = self.D
         axis = self.axis
@@ -1002,9 +1055,14 @@ class DistEngine:
             }
 
         out_specs = {"table": P(axis), "n": P(axis), "totals": P(axis)}
-        mapped = shard_map(shard_fn, mesh=self.mesh,
-                           in_specs=tuple(arg_specs), out_specs=out_specs,
-                           check_vma=False)
+        try:
+            mapped = shard_map(shard_fn, mesh=self.mesh,
+                               in_specs=tuple(arg_specs), out_specs=out_specs,
+                               check_vma=False)
+        except TypeError:  # pre-0.5 JAX names the replication check check_rep
+            mapped = shard_map(shard_fn, mesh=self.mesh,
+                               in_specs=tuple(arg_specs), out_specs=out_specs,
+                               check_rep=False)
         return jax.jit(mapped)
 
 

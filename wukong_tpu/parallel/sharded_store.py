@@ -51,6 +51,8 @@ class StackedIndex:
 
 class ShardedDeviceStore:
     def __init__(self, stores: list, mesh, axis: str = "x"):
+        from wukong_tpu.runtime.resilience import CircuitBreaker
+
         self.stores = stores
         self.mesh = mesh
         self.axis = axis
@@ -60,6 +62,11 @@ class ShardedDeviceStore:
         self._index_cache: dict = {}
         self.bytes_used = 0
         self._seen_version = self.version()
+        # resilience: per-shard circuit breaker over host-side fetches, and
+        # the set of shards whose data is currently missing from stagings
+        # (the dist engine tags replies incomplete while it is non-empty)
+        self.breaker = CircuitBreaker()
+        self.degraded_shards: set[int] = set()
 
     def version(self) -> int:
         """Max dynamic-insert version across all partitions."""
@@ -76,8 +83,48 @@ class ShardedDeviceStore:
             self._index_cache.clear()
             self.bytes_used = 0
             self._seen_version = v
+            # stagings are gone, so no staged data is missing any shard;
+            # the next staging re-evaluates shard health through the breaker
+            self.degraded_shards.clear()
             return True
         return False
+
+    def _fetch_shard(self, i: int, fn, what: str):
+        """One shard's host-side fetch through the resilience layer: the
+        ``dist.shard_fetch`` fault site, retry with backoff on transients,
+        and the per-shard circuit breaker. Returns (value, ok); ok=False
+        marks the shard degraded — the caller substitutes empty shard data
+        so the compiled chain routes around the shard instead of crashing.
+        A later successful fetch clears the degraded flag (recovery)."""
+        from wukong_tpu.runtime import faults
+        from wukong_tpu.runtime.resilience import retry_call
+        from wukong_tpu.utils.errors import RetryExhausted, ShardUnavailable
+        from wukong_tpu.utils.logger import log_warn
+
+        def attempt():
+            faults.site("dist.shard_fetch", shard=i)
+            return fn()
+
+        try:
+            out = retry_call(attempt, site=f"dist.shard_fetch[{i}]",
+                             retry_on=(faults.TransientFault,),
+                             breaker=self.breaker, key=i)
+        except faults.ShardDown as e:
+            # persistent fault: not retryable — retry_call already counted
+            # it toward the breaker, so repeated stagings trip it and stop
+            # touching the shard
+            log_warn(f"shard {i} down during {what} ({e}); substituting an "
+                     "empty shard — results will be flagged incomplete")
+            self.degraded_shards.add(i)
+            return None, False
+        except (ShardUnavailable, RetryExhausted) as e:
+            log_warn(f"shard {i} unavailable during {what} "
+                     f"({e.code.name}); substituting an empty shard — "
+                     "results will be flagged incomplete")
+            self.degraded_shards.add(i)
+            return None, False
+        self.degraded_shards.discard(i)
+        return out, True
 
     def _put(self, arr: np.ndarray):
         import jax
@@ -92,18 +139,26 @@ class ShardedDeviceStore:
         key = (int(pid), int(d))
         if key in self._cache:
             return self._cache[key]
-        shards = []
-        for g in self.stores:
+        empty3 = (np.empty(0, np.int64), np.zeros(1, np.int64),
+                  np.empty(0, np.int64))
+
+        def fetch(g):
             if pid == TYPE_ID and int(d) == IN:
-                shards.append(self._type_csr(g))
-            else:
-                host = g.segments.get(key)
-                shards.append((host.keys, host.offsets, host.edges)
-                              if host is not None else
-                              (np.empty(0, np.int64), np.zeros(1, np.int64),
-                               np.empty(0, np.int64)))
+                return self._type_csr(g)
+            host = g.segments.get(key)
+            return ((host.keys, host.offsets, host.edges)
+                    if host is not None else empty3)
+
+        shards = []
+        healthy = True
+        for i, g in enumerate(self.stores):
+            got, ok = self._fetch_shard(i, lambda g=g: fetch(g),
+                                        f"segment({pid},{d})")
+            healthy &= ok
+            shards.append(got if ok else empty3)
         if all(len(k) == 0 for (k, _, _) in shards):
-            self._cache[key] = None
+            if healthy:
+                self._cache[key] = None
             return None
         # SPMD-uniform sizing across shards
         max_k = max(len(k) for (k, _, _) in shards)
@@ -139,8 +194,11 @@ class ShardedDeviceStore:
             avg_deg=tot_e / max(tot_k, 1),
             max_deg=int(max_deg),
         )
-        self._cache[key] = seg
-        self.bytes_used += seg.nbytes
+        if healthy:
+            # degraded stagings are never cached: the next query re-stages,
+            # so a recovered shard's data reappears without a version bump
+            self._cache[key] = seg
+            self.bytes_used += seg.nbytes
         return seg
 
     def _type_csr(self, g):
@@ -161,9 +219,19 @@ class ShardedDeviceStore:
             return self._cache[key]
         from wukong_tpu.engine.device_store import combined_adjacency
 
-        shards = [combined_adjacency(g, d) for g in self.stores]
+        empty4 = (np.empty(0, np.int64), np.zeros(1, np.int64),
+                  np.empty(0, np.int64), np.empty(0, np.int64))
+        shards = []
+        healthy = True
+        for i, g in enumerate(self.stores):
+            got, ok = self._fetch_shard(
+                i, lambda g=g: combined_adjacency(g, d),
+                f"versatile_segment({d})")
+            healthy &= ok
+            shards.append(got if ok else empty4)
         if all(len(k) == 0 for (k, _, _, _) in shards):
-            self._cache[key] = None
+            if healthy:
+                self._cache[key] = None
             return None
         max_k = max(len(k) for (k, _, _, _) in shards)
         NB = max(_next_pow2((max_k + 3) // 4), 2)
@@ -200,8 +268,9 @@ class ShardedDeviceStore:
             avg_deg=tot_e / max(tot_k, 1),
             max_deg=int(max_deg),
         )
-        self._cache[key] = seg
-        self.bytes_used += seg.nbytes
+        if healthy:
+            self._cache[key] = seg
+            self.bytes_used += seg.nbytes
         return seg
 
     def host_max_deg(self, pid: int, d: int) -> int:
@@ -220,8 +289,15 @@ class ShardedDeviceStore:
         key = (int(tpid), int(d))
         if key in self._index_cache:
             return self._index_cache[key]
-        lists = [np.asarray(g.get_index(tpid, d), dtype=np.int32)
-                 for g in self.stores]
+        lists = []
+        healthy = True
+        for i, g in enumerate(self.stores):
+            got, ok = self._fetch_shard(
+                i, lambda g=g: np.asarray(g.get_index(tpid, d),
+                                          dtype=np.int32),
+                f"index_list({tpid},{d})")
+            healthy &= ok
+            lists.append(got if ok else np.empty(0, np.int32))
         L = _next_pow2(max(max((len(x) for x in lists), default=1), 1))
         stacked = np.full((self.D, L), INT32_MAX, dtype=np.int32)
         for i, x in enumerate(lists):
@@ -231,6 +307,7 @@ class ShardedDeviceStore:
             real_lens=np.asarray([len(x) for x in lists], dtype=np.int64),
             total=int(sum(len(x) for x in lists)),
         )
-        self._index_cache[key] = idx
-        self.bytes_used += stacked.nbytes
+        if healthy:
+            self._index_cache[key] = idx
+            self.bytes_used += stacked.nbytes
         return idx

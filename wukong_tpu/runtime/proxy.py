@@ -16,6 +16,7 @@ from wukong_tpu.config import Global
 from wukong_tpu.planner.heuristic import heuristic_plan
 from wukong_tpu.planner.plan_file import set_plan
 from wukong_tpu.runtime.monitor import Monitor
+from wukong_tpu.runtime.resilience import Deadline
 from wukong_tpu.sparql.ir import SPARQLQuery, SPARQLTemplate
 from wukong_tpu.sparql.parser import Parser
 from wukong_tpu.types import IN, OUT, is_tpid
@@ -89,6 +90,9 @@ class Proxy:
             qq = Parser(self.str_server).parse(text)
             qq.mt_factor = 1
             qq.result.blind = Global.silent if blind is None else blind
+            # per-query deadline + work budget from the resilience knobs
+            # (query_deadline_ms / query_budget_rows; None when both off)
+            qq.deadline = Deadline.from_config()
             self._plan(qq, plan_text)
             return qq
 
@@ -118,8 +122,31 @@ class Proxy:
                 t0 = get_usec()
                 host.execute(q)
                 total_us += get_usec() - t0
+            elif (q.result.status_code == ErrorCode.CAPACITY_EXCEEDED
+                  and eng is self.tpu and self.cpu is not None):
+                # graceful degradation: the device capacity ceiling is a
+                # TPU constraint, not a query property — the CPU engine has
+                # no capacity classes, so re-run host-side (the resilience
+                # analogue of the GPU->CPU spill in WCOJ-on-GPU engines)
+                log_info("device capacity exceeded; degrading to the CPU "
+                         "engine")
+                q = prepare()
+                t0 = get_usec()
+                self.cpu.execute(q)
+                total_us += get_usec() - t0
+            if q.result.status_code in (ErrorCode.QUERY_TIMEOUT,
+                                        ErrorCode.BUDGET_EXCEEDED):
+                break  # deadline/budget spent: further repeats are pointless
         if q.result.status_code != ErrorCode.SUCCESS:
-            log_error(f"query failed: {q.result.status_code.name}")
+            if not q.result.complete:
+                # structured partial reply, not a crash: the rows produced
+                # before the deadline/budget expiry are still in the table
+                log_error(
+                    f"query degraded: {q.result.status_code.name} — partial "
+                    f"result ({q.result.nrows} rows, "
+                    f"{len(q.result.dropped_patterns)} pattern(s) dropped)")
+            else:
+                log_error(f"query failed: {q.result.status_code.name}")
             return q
         log_info(f"(last) result rows: {q.result.nrows}, "
                  f"avg latency: {total_us / repeats:,.0f} usec ({repeats} runs)")

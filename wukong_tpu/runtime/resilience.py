@@ -1,0 +1,272 @@
+"""Resilience layer: deadlines, work budgets, retry/backoff, circuit breakers.
+
+The reference executes every query optimistically — a hung fetch stalls an
+engine thread forever and a result blowup OOMs the process (its only failure
+handling is turning engine exceptions into a reply status). This module adds
+the machinery GPU-side Datalog engines use to survive instead:
+
+- :class:`Deadline` — per-query wall-clock limit + intermediate-row work
+  budget, carried on the query (``q.deadline``) and checked at every BGP
+  step / chain attempt. Expiry raises structured ``QueryTimeout`` /
+  ``BudgetExceeded`` from utils/errors.py.
+- :func:`retry_call` — exponential backoff with decorrelated jitter around
+  transient failure points (shard fetches, HDFS reads, chain dispatch).
+- :class:`CircuitBreaker` — per-key consecutive-failure breaker with a
+  half-open probe after a cooldown, so a persistently-down shard is routed
+  around instead of re-paying its timeout on every query.
+- :func:`mark_partial` — graceful degradation: tag the reply incomplete
+  (``result.complete = False``) with the dropped patterns, keeping the rows
+  produced so far, instead of crashing the engine pool.
+
+All clocks/sleeps are injectable so the chaos suite replays schedules
+deterministically (tests/test_chaos.py).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+from wukong_tpu.config import Global
+from wukong_tpu.utils.errors import (
+    BudgetExceeded,
+    QueryTimeout,
+    RetryExhausted,
+    ShardUnavailable,
+)
+
+
+class Deadline:
+    """Wall-clock deadline + intermediate-row budget for one query."""
+
+    __slots__ = ("_clock", "_expires_at", "budget_rows", "rows_charged")
+
+    def __init__(self, timeout_ms: int = 0, budget_rows: int = 0,
+                 clock=time.monotonic):
+        self._clock = clock
+        self._expires_at = (clock() + timeout_ms / 1e3
+                            if timeout_ms and timeout_ms > 0 else None)
+        self.budget_rows = int(budget_rows or 0)
+        self.rows_charged = 0
+
+    @classmethod
+    def from_config(cls) -> "Deadline | None":
+        """A Deadline per the Global knobs, or None when both are off."""
+        if Global.query_deadline_ms <= 0 and Global.query_budget_rows <= 0:
+            return None
+        return cls(Global.query_deadline_ms, Global.query_budget_rows)
+
+    def expired(self) -> bool:
+        return self._expires_at is not None and self._clock() >= self._expires_at
+
+    def remaining_s(self) -> float | None:
+        if self._expires_at is None:
+            return None
+        return max(self._expires_at - self._clock(), 0.0)
+
+    def check(self, where: str = "") -> None:
+        if self.expired():
+            raise QueryTimeout(where)
+
+    def charge_rows(self, n: int, where: str = "") -> None:
+        self.rows_charged += int(n)
+        if self.budget_rows and self.rows_charged > self.budget_rows:
+            raise BudgetExceeded(
+                f"{self.rows_charged:,} rows > budget "
+                f"{self.budget_rows:,}" + (f" at {where}" if where else ""))
+
+
+def check_query(q, where: str = "") -> None:
+    """Deadline check for a query that may or may not carry one."""
+    dl = getattr(q, "deadline", None)
+    if dl is not None:
+        dl.check(where)
+
+
+def charge_query(q, rows: int, where: str = "") -> None:
+    """Charge a step's output rows against the query's work budget."""
+    dl = getattr(q, "deadline", None)
+    if dl is not None:
+        dl.charge_rows(rows, where)
+
+
+def mark_partial(q, exc) -> None:
+    """Graceful degradation on deadline/budget expiry: keep the rows
+    produced so far, record what was dropped, surface the structured code."""
+    res = q.result
+    res.status_code = exc.code
+    res.complete = False
+    dropped = [repr(p) for p in q.pattern_group.patterns[q.pattern_step:]]
+    if q.pattern_group.unions and not q.union_done:
+        dropped.append(f"UNION x{len(q.pattern_group.unions)}")
+    dropped += [f"OPTIONAL#{i}" for i in
+                range(q.optional_step, len(q.pattern_group.optional))]
+    res.dropped_patterns = dropped
+    if not Global.enable_partial_results:
+        import numpy as np
+
+        res.table = np.empty((0, res.col_num), dtype=np.int64)
+        res.nrows = 0
+
+
+# ---------------------------------------------------------------------------
+# retry with exponential backoff + jitter
+# ---------------------------------------------------------------------------
+
+_retry_rng = random.Random()  # jitter source; tests inject their own
+
+
+def retry_call(fn, *, site: str = "", attempts: int | None = None,
+               base_ms: float | None = None, max_ms: float | None = None,
+               retry_on: tuple = (), breaker: "CircuitBreaker | None" = None,
+               key=None, rng: random.Random | None = None, sleep=time.sleep,
+               deadline: Deadline | None = None):
+    """Call ``fn()``; on an exception in ``retry_on`` back off and retry.
+
+    Backoff is exponential with equal jitter: half the window fixed, half
+    uniform, so synchronized retry storms decorrelate. A breaker (keyed by
+    ``key``) short-circuits calls while open and records outcomes; a
+    deadline bounds the total retry time. Non-retryable exceptions (and
+    faults.ShardDown) propagate immediately. Exhaustion raises
+    RetryExhausted carrying the last exception.
+    """
+    from wukong_tpu.runtime.faults import TransientFault
+
+    attempts = Global.retry_max_attempts if attempts is None else attempts
+    base_ms = Global.retry_base_ms if base_ms is None else base_ms
+    max_ms = Global.retry_max_ms if max_ms is None else max_ms
+    retry_on = tuple(retry_on) or (TransientFault, OSError)
+    rng = rng or _retry_rng
+    attempts = max(int(attempts), 1)
+    last: BaseException | None = None
+    for i in range(attempts):
+        if breaker is not None and not breaker.allow(key):
+            raise ShardUnavailable(
+                f"circuit open for {key!r} at {site}", shard=key
+                if isinstance(key, int) else None)
+        # past this point an admitted half-open trial MUST be settled on
+        # every exit path (success/failure/abort) or the breaker wedges with
+        # its trial slot held forever
+        if deadline is not None:
+            try:
+                deadline.check(site)
+            except BaseException:
+                if breaker is not None:
+                    # cancelled before dispatch: release the trial slot
+                    # without judging the shard either way
+                    breaker.record_abort(key)
+                raise
+        try:
+            out = fn()
+        except retry_on as e:
+            last = e
+            if breaker is not None:
+                breaker.record_failure(key)
+            if i == attempts - 1:
+                break
+            window = min(base_ms * (2 ** i), max_ms) / 1e3
+            delay = window / 2 + rng.random() * window / 2
+            if deadline is not None:
+                rem = deadline.remaining_s()
+                if rem is not None and delay >= rem:
+                    raise QueryTimeout(
+                        f"deadline inside retry backoff at {site}") from e
+            sleep(delay)
+        except BaseException:
+            # non-retryable failure (ShardDown, a store bug, ...): the call
+            # did run and did fail — count it so persistent faults trip the
+            # breaker, and so an admitted half-open trial is settled
+            if breaker is not None:
+                breaker.record_failure(key)
+            raise
+        else:
+            if breaker is not None:
+                breaker.record_success(key)
+            return out
+    raise RetryExhausted(
+        f"{attempts} attempts failed at {site}: {last!r}", last=last)
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker
+# ---------------------------------------------------------------------------
+
+class CircuitBreaker:
+    """Per-key consecutive-failure circuit breaker.
+
+    closed -> (threshold consecutive failures) -> open -> (cooldown) ->
+    half-open: one trial call is allowed; success closes the breaker,
+    failure reopens it for another cooldown. Thread-safe — the engine pool
+    and the proxy share one instance per subsystem.
+    """
+
+    def __init__(self, threshold: int | None = None,
+                 cooldown_ms: float | None = None, clock=time.monotonic):
+        self.threshold = (Global.breaker_threshold
+                          if threshold is None else int(threshold))
+        self.cooldown_s = (Global.breaker_cooldown_ms
+                           if cooldown_ms is None else cooldown_ms) / 1e3
+        self._clock = clock
+        self._lock = threading.Lock()
+        # key -> [consecutive_failures, opened_at | None, half_open_inflight]
+        self._st: dict = {}
+
+    def _slot(self, key):
+        return self._st.setdefault(key, [0, None, False])
+
+    def state(self, key) -> str:
+        with self._lock:
+            fails, opened_at, half = self._slot(key)
+            if opened_at is None:
+                return "closed"
+            if half or self._clock() - opened_at >= self.cooldown_s:
+                return "half_open"
+            return "open"
+
+    def allow(self, key) -> bool:
+        """True when a call may proceed. The transition to half-open admits
+        ONE trial at a time; concurrent callers keep getting False until
+        the trial reports an outcome."""
+        with self._lock:
+            slot = self._slot(key)
+            fails, opened_at, half = slot
+            if opened_at is None:
+                return True
+            if half:
+                return False  # a trial is already in flight
+            if self._clock() - opened_at >= self.cooldown_s:
+                slot[2] = True  # admit the half-open trial
+                return True
+            return False
+
+    def record_success(self, key) -> None:
+        with self._lock:
+            self._st[key] = [0, None, False]
+
+    def record_abort(self, key) -> None:
+        """The admitted call never dispatched (e.g. deadline expiry between
+        allow() and the call): release a held half-open trial slot without
+        judging the shard either way. No-op for closed keys."""
+        with self._lock:
+            self._slot(key)[2] = False
+
+    def record_failure(self, key) -> None:
+        with self._lock:
+            slot = self._slot(key)
+            slot[0] += 1
+            if slot[1] is not None:
+                # failed half-open trial (or failure while open): reopen
+                slot[1] = self._clock()
+                slot[2] = False
+            elif slot[0] >= self.threshold:
+                slot[1] = self._clock()
+                slot[2] = False
+
+    def tripped(self, key) -> bool:
+        return self.state(key) != "closed"
+
+    def tripped_keys(self) -> list:
+        with self._lock:
+            now = self._clock()
+            return [k for k, (f, o, h) in self._st.items() if o is not None]

@@ -233,6 +233,19 @@ class EnginePool:
             self._inflight[tid] = item
             self._busy_since[tid] = get_usec()
             try:
+                # a query whose deadline expired while queued fails fast
+                # with a structured QueryTimeout instead of occupying the
+                # engine (the resilience layer's load-shedding path); the
+                # pool keeps serving — nothing wedges
+                dl = getattr(query, "deadline", None)
+                if dl is not None and dl.expired():
+                    from wukong_tpu.utils.errors import QueryTimeout
+
+                    raise QueryTimeout(
+                        f"deadline expired in engine-{tid} queue")
+                from wukong_tpu.runtime import faults
+
+                faults.site("pool.execute", shard=tid)
                 out = engine.execute(query)
             except Exception as e:  # engine errors become the reply
                 out = e

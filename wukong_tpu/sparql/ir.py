@@ -103,6 +103,12 @@ class Result:
         self.status_code = ErrorCode.SUCCESS
         self.nrows = 0  # meaningful even when blind/table cleared
         self.optional_matched_rows: np.ndarray | None = None
+        # resilience: False when the reply is a graceful degradation — a
+        # deadline/budget expiry kept the rows produced so far, or a down
+        # shard's contribution is missing. dropped_patterns lists what was
+        # not executed / not fully served (pattern reprs or shard tags).
+        self.complete = True
+        self.dropped_patterns: list[str] = []
 
     def var2col(self, var: int) -> int:
         return self.v2c_map.get(var, NO_RESULT)
@@ -177,6 +183,10 @@ class SPARQLQuery:
     # empty result query" — generate_plan returns false and the proxy skips
     # execution). Engines honor it under Global.enable_empty_shortcircuit.
     planner_empty: bool = False
+    # per-query Deadline (runtime/resilience.py) — wall-clock + row budget.
+    # None = unconstrained. Engines check it at each BGP step; the proxy
+    # attaches one from the Global knobs and children inherit the parent's.
+    deadline: object = None
 
     def get_pattern(self, step: int | None = None) -> Pattern:
         s = self.pattern_step if step is None else step

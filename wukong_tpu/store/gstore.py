@@ -123,7 +123,11 @@ def check_vid_range(triples: np.ndarray) -> None:
     """Device staging narrows ids to int32 (types.py documents the <2^31
     assumption), and INT32_MAX itself is the device-side padding/dead-row
     sentinel — so ids must stay strictly below 2^31 - 1 or they wrap/collide
-    silently into wrong query results."""
+    silently into wrong query results. The minimum matters too: the native
+    radix sort (wukong_native.cpp) extracts unsigned digits and relies on
+    non-negative ids, so a negative id mis-sorts on the native path while
+    the np.lexsort fallback orders it correctly — a toolchain-dependent
+    store divergence unless rejected here (ADVICE.md round-5 #1)."""
     if len(triples) and int(triples.max()) >= 2**31 - 1:
         from wukong_tpu.utils.errors import ErrorCode, WukongError
 
@@ -131,6 +135,13 @@ def check_vid_range(triples: np.ndarray) -> None:
             ErrorCode.UNKNOWN_PATTERN,
             f"vertex id {int(triples.max())} >= 2^31 - 1: ids no longer fit "
             "the int32 device representation (see types.py)")
+    if len(triples) and int(triples.min()) < 0:
+        from wukong_tpu.utils.errors import ErrorCode, WukongError
+
+        raise WukongError(
+            ErrorCode.UNKNOWN_PATTERN,
+            f"vertex id {int(triples.min())} < 0: ids must be non-negative "
+            "(the native radix sort's unsigned-digit contract)")
 
 
 def _triple_argsort(primary, secondary, tertiary) -> np.ndarray:

@@ -24,6 +24,15 @@ class ErrorCode(enum.IntEnum):
     UNKNOWN_PLAN = 11
     UNSUPPORTED_SHAPE = 12  # engine cannot run this plan shape (fallback-able)
     FILE_NOT_FOUND = 13  # dataset/HDFS source unreachable
+    # ---- resilience taxonomy (no reference analogue: the reference's only
+    # failure handling is "engine-side failures become a status_code"; these
+    # make deadline/budget/infrastructure failures distinguishable so the
+    # proxy can degrade instead of treating everything as a query bug) ----
+    QUERY_TIMEOUT = 14  # per-query wall-clock deadline expired
+    BUDGET_EXCEEDED = 15  # per-query intermediate-row work budget exhausted
+    CAPACITY_EXCEEDED = 16  # device capacity ceiling hit (host-fallback-able)
+    SHARD_UNAVAILABLE = 17  # shard down / circuit breaker open
+    RETRY_EXHAUSTED = 18  # transient-failure retries used up
 
 
 _MESSAGES = {
@@ -41,6 +50,11 @@ _MESSAGES = {
     ErrorCode.UNKNOWN_PLAN: "invalid or missing query plan",
     ErrorCode.UNSUPPORTED_SHAPE: "plan shape unsupported by this engine",
     ErrorCode.FILE_NOT_FOUND: "dataset source unreachable",
+    ErrorCode.QUERY_TIMEOUT: "query deadline expired",
+    ErrorCode.BUDGET_EXCEEDED: "query work budget exhausted",
+    ErrorCode.CAPACITY_EXCEEDED: "device capacity exceeded",
+    ErrorCode.SHARD_UNAVAILABLE: "shard unavailable (circuit open)",
+    ErrorCode.RETRY_EXHAUSTED: "transient-failure retries exhausted",
 }
 
 
@@ -52,6 +66,45 @@ class WukongError(Exception):
         self.detail = detail
         msg = _MESSAGES.get(self.code, "unknown error")
         super().__init__(f"[{self.code.name}] {msg}" + (f": {detail}" if detail else ""))
+
+
+class QueryTimeout(WukongError):
+    """Per-query wall-clock deadline expired (resilience layer)."""
+
+    def __init__(self, detail: str = ""):
+        super().__init__(ErrorCode.QUERY_TIMEOUT, detail)
+
+
+class BudgetExceeded(WukongError):
+    """Per-query intermediate-row work budget exhausted (resilience layer)."""
+
+    def __init__(self, detail: str = ""):
+        super().__init__(ErrorCode.BUDGET_EXCEEDED, detail)
+
+
+class CapacityExceeded(WukongError):
+    """A device capacity ceiling (table_capacity_max) was hit. The proxy
+    treats this as degradable: the CPU engine has no capacity classes, so
+    the same query can complete host-side."""
+
+    def __init__(self, detail: str = ""):
+        super().__init__(ErrorCode.CAPACITY_EXCEEDED, detail)
+
+
+class ShardUnavailable(WukongError):
+    """A shard is down or its circuit breaker is open."""
+
+    def __init__(self, detail: str = "", shard: int | None = None):
+        self.shard = shard
+        super().__init__(ErrorCode.SHARD_UNAVAILABLE, detail)
+
+
+class RetryExhausted(WukongError):
+    """A transient failure survived every retry attempt."""
+
+    def __init__(self, detail: str = "", last: BaseException | None = None):
+        self.last = last
+        super().__init__(ErrorCode.RETRY_EXHAUSTED, detail)
 
 
 def assert_ec(cond: bool, code: ErrorCode, detail: str = "") -> None:
