@@ -1,0 +1,113 @@
+"""Streaming micro-benchmark: sustained insert throughput + standing-query lag.
+
+Replays a seeded LUBM datagen graph into a DynamicGStore as fixed-size epoch
+batches — first bare (ingest-only inserts/sec), then with standing queries
+registered (per-epoch eval latency and commit-to-results lag from the
+Monitor's stream CDFs). Emits BENCH_STREAM.json next to the other BENCH_*
+artifacts.
+
+Usage:
+    JAX_PLATFORMS=cpu python scripts/bench_stream.py \
+        [--scale 1] [--batch 4096] [--base-frac 0.5] [--out BENCH_STREAM.json]
+"""
+
+import argparse
+import json
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+import numpy as np
+
+STANDING = {
+    "onehop": """
+PREFIX ub: <http://swat.cse.lehigh.edu/onto/univ-bench.owl#>
+SELECT ?X ?Y WHERE { ?X ub:memberOf ?Y . }""",
+    "chain2": """
+PREFIX ub: <http://swat.cse.lehigh.edu/onto/univ-bench.owl#>
+SELECT ?X ?Y ?Z WHERE {
+    ?X ub:memberOf ?Y .
+    ?Y ub:subOrganizationOf ?Z .
+}""",
+    "const_type": """
+PREFIX rdf: <http://www.w3.org/1999/02/22-rdf-syntax-ns#>
+PREFIX ub: <http://swat.cse.lehigh.edu/onto/univ-bench.owl#>
+SELECT ?X WHERE {
+    ?X ub:worksFor <http://www.Department0.University0.edu> .
+    ?X rdf:type ub:FullProfessor .
+}""",
+}
+
+
+def _run(base, live, ss, batch, queries):
+    from wukong_tpu.runtime.monitor import Monitor
+    from wukong_tpu.store.gstore import build_partition
+    from wukong_tpu.stream import ReplaySource, StreamContext
+
+    mon = Monitor()
+    ctx = StreamContext([build_partition(base, 0, 1)], ss, monitor=mon)
+    qids = {name: ctx.register(text) for name, text in queries.items()}
+    t0 = time.perf_counter()
+    recs = ctx.feed_source(ReplaySource(live, batch_size=batch))
+    wall_s = time.perf_counter() - t0
+    stats = mon.stream_stats()
+    return {
+        "epochs": len(recs),
+        "triples_streamed": int(sum(r.n_triples for r in recs)),
+        "edges_inserted": int(sum(r.n_inserted for r in recs)),
+        "wall_s": wall_s,
+        "inserts_per_s": sum(r.n_triples for r in recs) / wall_s,
+        "epochs_per_s": len(recs) / wall_s,
+        "ingest_us_cdf": stats["ingest_us_cdf"],
+        "eval_us_cdf": stats["eval_us_cdf"],
+        "lag_us_cdf": stats["lag_us_cdf"],
+        "standing_rows": {name: int(len(ctx.result_set(qid)))
+                          for name, qid in qids.items()},
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--scale", type=int, default=1, help="LUBM universities")
+    ap.add_argument("--batch", type=int, default=4096, help="epoch batch size")
+    ap.add_argument("--base-frac", type=float, default=0.5,
+                    help="fraction of the graph preloaded before streaming")
+    ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument("--out", default="BENCH_STREAM.json")
+    args = ap.parse_args()
+
+    from wukong_tpu.loader.lubm import VirtualLubmStrings, generate_lubm
+
+    triples, _ = generate_lubm(args.scale, seed=args.seed)
+    ss = VirtualLubmStrings(args.scale, seed=args.seed)
+    rng = np.random.default_rng(args.seed)
+    perm = rng.permutation(len(triples))
+    n_base = int(len(triples) * args.base_frac)
+    base, live = triples[perm[:n_base]], triples[perm[n_base:]]
+
+    out = {
+        "bench": "stream",
+        "scale": args.scale,
+        "batch": args.batch,
+        "seed": args.seed,
+        "n_base": int(n_base),
+        "n_live": int(len(live)),
+        # ingest-only ceiling first, then the standing-query runs on top
+        "ingest_only": _run(base, live, ss, args.batch, {}),
+        "with_standing": _run(base, live, ss, args.batch, STANDING),
+    }
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=1, sort_keys=True)
+    io, ws = out["ingest_only"], out["with_standing"]
+    print(json.dumps({
+        "ingest_only_inserts_per_s": round(io["inserts_per_s"]),
+        "with_standing_inserts_per_s": round(ws["inserts_per_s"]),
+        "lag_p50_us": ws["lag_us_cdf"].get(0.5),
+        "lag_p99_us": ws["lag_us_cdf"].get(0.99),
+        "standing_rows": ws["standing_rows"],
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,618 @@
+"""Streaming subsystem: delta-vs-oracle correctness, windows, ingest chaos.
+
+The acceptance bar (ISSUE 2): standing-query results after N streamed epochs
+must be byte-identical to a from-scratch run of the same query on the final
+graph — checked here for one-hop, chain, const-anchored, and FILTER shapes,
+plus a windowed query whose oracle is the surviving window contents after
+retractions. Chaos tests drive the `stream.ingest` / `dynamic.insert` fault
+sites through the ingest retry path.
+"""
+
+import numpy as np
+import pytest
+
+from wukong_tpu.engine.cpu import CPUEngine
+from wukong_tpu.loader.lubm import VirtualLubmStrings, generate_lubm
+from wukong_tpu.planner.heuristic import heuristic_plan
+from wukong_tpu.runtime import faults
+from wukong_tpu.runtime.faults import FaultPlan, FaultSpec, TransientFault
+from wukong_tpu.runtime.monitor import Monitor
+from wukong_tpu.runtime.proxy import Proxy
+from wukong_tpu.runtime.resilience import CircuitBreaker
+from wukong_tpu.runtime.scheduler import EnginePool
+from wukong_tpu.sparql.parser import Parser
+from wukong_tpu.store.gstore import build_partition
+from wukong_tpu.stream import (
+    EpochWindow,
+    FileSource,
+    ReplaySource,
+    StreamContext,
+    WindowSpec,
+)
+from wukong_tpu.utils.errors import ErrorCode, RetryExhausted, WukongError
+
+pytestmark = pytest.mark.stream
+
+PREFIX = """
+PREFIX rdf: <http://www.w3.org/1999/02/22-rdf-syntax-ns#>
+PREFIX ub: <http://swat.cse.lehigh.edu/onto/univ-bench.owl#>
+"""
+Q_ONEHOP = PREFIX + "SELECT ?X ?Y WHERE { ?X ub:memberOf ?Y . }"
+Q_CHAIN = PREFIX + """SELECT ?X ?Y ?Z WHERE {
+    ?X ub:memberOf ?Y .
+    ?Y ub:subOrganizationOf ?Z .
+}"""
+Q_CONST = PREFIX + """SELECT ?X WHERE {
+    ?X ub:worksFor <http://www.Department0.University0.edu> .
+    ?X rdf:type ub:FullProfessor .
+}"""
+Q_FILTER = PREFIX + """SELECT ?X ?Y ?Z WHERE {
+    ?X ub:advisor ?Y .
+    ?X ub:memberOf ?Z .
+    FILTER ( ?Y != ?Z )
+}"""
+
+
+@pytest.fixture(scope="module")
+def world():
+    triples, lay = generate_lubm(1, seed=42)
+    ss = VirtualLubmStrings(1, seed=42)
+    rng = np.random.default_rng(0)
+    perm = rng.permutation(len(triples))
+    return triples, ss, perm
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def full_run(triples, ss, text) -> np.ndarray:
+    """Oracle: from-scratch evaluation on a freshly-built partition,
+    projected to the required vars, distinct, row-sorted."""
+    g = build_partition(triples, 0, 1)
+    q = Parser(ss).parse(text)
+    heuristic_plan(q)
+    q.result.blind = True
+    CPUEngine(g, ss).execute(q, from_proxy=False)
+    cols = [q.result.var2col(v) for v in q.result.required_vars]
+    if q.result.nrows == 0:
+        return np.empty((0, len(cols)), dtype=np.int64)
+    return np.unique(q.result.table[:, cols], axis=0)
+
+
+def split(triples, perm, n_base):
+    return triples[perm[:n_base]], triples[perm[n_base:]]
+
+
+# ---------------------------------------------------------------------------
+# delta-vs-oracle: streamed epochs == from-scratch run on the final graph
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("text", [Q_ONEHOP, Q_CHAIN, Q_CONST, Q_FILTER],
+                         ids=["onehop", "chain", "const", "filter"])
+def test_delta_matches_oracle(world, text):
+    triples, ss, perm = world
+    base, live = split(triples, perm, len(triples) // 2)
+    ctx = StreamContext([build_partition(base, 0, 1)], ss)
+    qid = ctx.register(text)
+    recs = ctx.feed_source(ReplaySource(live, batch_size=4096))
+    assert len(recs) == -(-len(live) // 4096)  # every batch became an epoch
+    oracle = full_run(triples, ss, text)
+    got = ctx.result_set(qid)
+    assert got.shape == oracle.shape
+    assert np.array_equal(got, oracle)  # byte-identical
+    # the default poll returns the full history (incl. the registration
+    # snapshot): append-only +1 deltas that sum to the result set
+    deltas = ctx.poll(qid)
+    assert all(d.sign == +1 for d in deltas)
+    assert sum(len(d.rows) for d in deltas) == len(oracle)
+
+
+def test_registration_snapshot_seeds_base_results(world):
+    """Results already derivable at registration time appear without any
+    epoch — and streaming on top never re-emits them."""
+    triples, ss, perm = world
+    base, live = split(triples, perm, len(triples) // 2)
+    ctx = StreamContext([build_partition(base, 0, 1)], ss)
+    qid = ctx.register(Q_ONEHOP)
+    snap = ctx.result_set(qid)
+    assert np.array_equal(snap, full_run(base, ss, Q_ONEHOP))
+    ctx.feed_source(ReplaySource(live, batch_size=8192))
+    seen = set()
+    for d in ctx.poll(qid):
+        rows = set(map(tuple, d.rows.tolist()))
+        assert not rows & seen  # no row is ever emitted twice
+        seen |= rows
+
+
+def test_epoch_order_invariance(world):
+    """Different batch sizes (= different epoch boundaries) converge to the
+    identical standing result."""
+    triples, ss, perm = world
+    base, live = split(triples, perm, len(triples) // 2)
+    results = []
+    for bs in (1024, 16384):
+        ctx = StreamContext([build_partition(base, 0, 1)], ss)
+        qid = ctx.register(Q_CHAIN)
+        ctx.feed_source(ReplaySource(live, batch_size=bs))
+        results.append(ctx.result_set(qid))
+    assert np.array_equal(results[0], results[1])
+
+
+def test_poll_since_epoch_and_unregister(world):
+    triples, ss, perm = world
+    base, live = split(triples, perm, len(triples) - 3000)
+    ctx = StreamContext([build_partition(base, 0, 1)], ss)
+    qid = ctx.register(Q_ONEHOP)
+    ctx.feed_source(ReplaySource(live, batch_size=1000))
+    assert ctx.epoch == 3
+    all_deltas = ctx.poll(qid)
+    # default poll covers the registration snapshot (epoch 0 here) — the
+    # same coverage a late registrant would see — and a cursor filters it
+    assert all_deltas[0].epoch == 0
+    late = ctx.poll(qid, since_epoch=2)
+    assert late == [d for d in all_deltas if d.epoch > 2]
+    # pruning behind the cursor frees history without touching the result
+    before = len(ctx.result_set(qid))
+    assert ctx.prune(qid, upto_epoch=2) == len(
+        [d for d in all_deltas if d.epoch <= 2])
+    assert ctx.poll(qid) == late
+    assert len(ctx.result_set(qid)) == before
+    ctx.unregister(qid)
+    with pytest.raises(WukongError):
+        ctx.poll(qid)
+    with pytest.raises(WukongError):
+        ctx.unregister(qid)
+
+
+# ---------------------------------------------------------------------------
+# windows: retirement, retraction, windowed oracle
+# ---------------------------------------------------------------------------
+
+def test_epoch_window_sliding():
+    w = EpochWindow(spec=WindowSpec(size=3, slide=1))
+    retired = {e: [r for r, _ in w.add(e, np.empty((0, 3), dtype=np.int64))]
+               for e in range(1, 6)}
+    assert retired == {1: [], 2: [], 3: [], 4: [1], 5: [2]}
+    assert w.live_epochs() == [3, 4, 5]
+
+
+def test_epoch_window_tumbling():
+    w = EpochWindow(spec=WindowSpec.tumbling(2))
+    retired = {e: [r for r, _ in w.add(e, np.empty((0, 3), dtype=np.int64))]
+               for e in range(1, 7)}
+    # the previous window retires in bulk as soon as the next one opens —
+    # a mid-window epoch never sees an already-reported window
+    assert retired == {1: [], 2: [], 3: [1, 2], 4: [], 5: [3, 4], 6: []}
+    assert w.live_epochs() == [5, 6]
+
+
+def test_window_spec_validation():
+    with pytest.raises(ValueError):
+        WindowSpec(size=0)
+    with pytest.raises(ValueError):
+        WindowSpec(size=2, slide=3)
+    with pytest.raises(WukongError):
+        StreamContext([build_partition(
+            np.asarray([[5, 1, 6]], dtype=np.int64), 0, 1)]).register(
+                Q_ONEHOP, window="not-a-spec")
+
+
+def _surviving(batches, spec: WindowSpec):
+    """Independent re-derivation of the documented retirement rule."""
+    live = []
+    for e, batch in enumerate(batches, start=1):
+        live.append((e, batch))
+        cutoff = (e - 1) // spec.slide * spec.slide - (spec.size - spec.slide)
+        live = [ent for ent in live if ent[0] > cutoff]
+    return np.concatenate([b for _, b in live])
+
+
+@pytest.mark.parametrize("spec", [WindowSpec(size=3, slide=1),
+                                  WindowSpec.tumbling(2)],
+                         ids=["sliding", "tumbling"])
+def test_windowed_delta_matches_window_oracle(world, spec):
+    """After retractions, the standing result is byte-identical to a
+    from-scratch run over base_triples + the surviving window epochs."""
+    triples, ss, perm = world
+    base, live = split(triples, perm, len(triples) // 2)
+    live = live[:12000]
+    ctx = StreamContext([build_partition(base, 0, 1)], ss)
+    qid = ctx.register(Q_ONEHOP, window=spec, base_triples=base)
+    batches = [b for _, b in ReplaySource(live, batch_size=2000)]
+    for b in batches:
+        ctx.feed(b)
+    assert ctx.epoch == 6  # enough epochs that the window closed and retired
+    deltas = ctx.poll(qid)  # full history incl. the registration snapshot
+    assert any(d.sign == -1 for d in deltas)  # retraction actually happened
+    oracle = full_run(np.concatenate([base, _surviving(batches, spec)]),
+                      ss, Q_ONEHOP)
+    assert np.array_equal(ctx.result_set(qid), oracle)
+    # replaying the sink (additions minus retractions) rebuilds the set
+    acc: set = set()
+    for d in deltas:
+        rows = set(map(tuple, d.rows.tolist()))
+        acc = acc | rows if d.sign > 0 else acc - rows
+    assert np.array_equal(np.asarray(sorted(acc), dtype=np.int64), oracle)
+
+
+def test_tumbling_mid_window_never_joins_previous_window(world):
+    """At a mid-window epoch a tumbling query's result must reflect ONLY
+    the current (open) window — never transient rows joined against the
+    previous, already-retired window."""
+    triples, ss, perm = world
+    base, live = split(triples, perm, len(triples) // 2)
+    live = live[:6000]
+    spec = WindowSpec.tumbling(2)
+    ctx = StreamContext([build_partition(base, 0, 1)], ss)
+    qid = ctx.register(Q_ONEHOP, window=spec, base_triples=base)
+    batches = [b for _, b in ReplaySource(live, batch_size=2000)]
+    for b in batches:
+        ctx.feed(b)
+    assert ctx.epoch == 3  # mid-window: window [3,4] is open with only 3
+    oracle = full_run(np.concatenate([base, batches[2]]), ss, Q_ONEHOP)
+    assert np.array_equal(ctx.result_set(qid), oracle)
+
+
+# ---------------------------------------------------------------------------
+# sources
+# ---------------------------------------------------------------------------
+
+def test_replay_source_batching_and_ts():
+    src = ReplaySource(np.arange(21, dtype=np.int64).reshape(7, 3),
+                       batch_size=3, start_ts=10.0, ts_step=0.5)
+    got = list(src)
+    assert [ts for ts, _ in got] == [10.0, 10.5, 11.0]
+    assert [len(b) for _, b in got] == [3, 3, 1]
+    with pytest.raises(WukongError):
+        ReplaySource(np.arange(8), batch_size=2)
+    with pytest.raises(WukongError):
+        ReplaySource(np.arange(9).reshape(3, 3), batch_size=0)
+
+
+def test_file_source_three_and_four_col(tmp_path):
+    f3 = tmp_path / "id_uni0.nt"
+    np.savetxt(f3, np.asarray([[5, 1, 6], [7, 1, 8], [9, 1, 10]]), fmt="%d")
+    got = list(FileSource(str(f3), batch_size=2))
+    assert [len(b) for _, b in got] == [2, 1]
+    # 4-col: rows regrouped per timestamp, epochs never mix timestamps
+    f4 = tmp_path / "id_ts"
+    f4.mkdir()
+    rows = np.asarray([[5, 1, 6, 2], [7, 1, 8, 1], [9, 1, 10, 2],
+                       [11, 1, 12, 1]])
+    np.savetxt(f4 / "id_all.nt", rows, fmt="%d")
+    got = list(FileSource(str(f4), batch_size=10))
+    assert [ts for ts, _ in got] == [1.0, 2.0]
+    assert sorted(got[0][1][:, 0].tolist()) == [7, 11]
+    assert sorted(got[1][1][:, 0].tolist()) == [5, 9]
+    empty = tmp_path / "empty-dir"
+    empty.mkdir()
+    with pytest.raises(WukongError):
+        list(FileSource(str(empty)))
+
+
+# ---------------------------------------------------------------------------
+# registration-time rejections: structured errors, never silent wrong answers
+# ---------------------------------------------------------------------------
+
+def _ctx(world):
+    triples, ss, perm = world
+    base, _ = split(triples, perm, 2000)
+    return StreamContext([build_partition(base, 0, 1)], ss)
+
+
+def test_reject_limit_offset(world):
+    with pytest.raises(WukongError) as ei:
+        _ctx(world).register(Q_ONEHOP + " LIMIT 5")
+    assert ei.value.code == ErrorCode.UNSUPPORTED_SHAPE
+
+
+def test_reject_cartesian_product(world):
+    q = PREFIX + """SELECT ?X ?Z WHERE {
+        ?X ub:memberOf ?Y .
+        ?Z ub:worksFor ?W .
+    }"""
+    with pytest.raises(WukongError) as ei:
+        _ctx(world).register(q)
+    assert ei.value.code == ErrorCode.UNSUPPORTED_SHAPE
+
+
+def test_reject_fully_constant_pattern(world):
+    q = PREFIX + """SELECT ?X WHERE {
+        <http://www.Department0.University0.edu>
+            ub:subOrganizationOf <http://www.University0.edu> .
+        ?X ub:worksFor <http://www.Department0.University0.edu> .
+    }"""
+    with pytest.raises(WukongError) as ei:
+        _ctx(world).register(q)
+    assert ei.value.code == ErrorCode.UNSUPPORTED_SHAPE
+
+
+# ---------------------------------------------------------------------------
+# chaos: ingest fault sites through the retry path
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("site", ["stream.ingest", "dynamic.insert"])
+def test_transient_ingest_fault_retried_to_oracle(world, site):
+    """Transient faults at either ingest-path site are retried (dedup makes
+    the replay idempotent) and the standing result still matches the
+    oracle exactly."""
+    triples, ss, perm = world
+    base, live = split(triples, perm, len(triples) // 2)
+    ctx = StreamContext([build_partition(base, 0, 1)], ss)
+    qid = ctx.register(Q_CHAIN)
+    faults.install(FaultPlan([FaultSpec(site, "transient", count=2)], seed=7))
+    recs = ctx.feed_source(ReplaySource(live, batch_size=8192))
+    assert faults.active().specs[0].fired == 2
+    assert [r.epoch for r in recs] == list(range(1, len(recs) + 1))
+    assert np.array_equal(ctx.result_set(qid), full_run(triples, ss, Q_CHAIN))
+
+
+@pytest.mark.chaos
+def test_retried_partial_multi_store_ingest_counts_every_edge(world):
+    """A transient after the first store committed must not lose that
+    store's edges from the epoch's n_inserted accounting (the replay
+    dedups them to 0)."""
+    from wukong_tpu.store.gstore import build_all_partitions
+
+    triples, ss, perm = world
+    base, live = split(triples, perm, len(triples) // 2)
+    batch = live[:2000]
+
+    def run(spec):
+        stores = build_all_partitions(base, 2)
+        ctx = StreamContext(stores, ss)
+        faults.install(FaultPlan([spec] if spec else [], seed=7))
+        return ctx.feed(batch).n_inserted
+
+    clean = run(None)
+    # fault only the SECOND store's insert: store 0 commits, then the epoch
+    # retries and store 0's replay dedups to 0
+    faulted = run(FaultSpec("dynamic.insert", "transient", count=1, shard=1))
+    assert faults.active().specs[0].fired == 1
+    assert faulted == clean
+
+
+@pytest.mark.chaos
+def test_windowed_query_survives_window_insert_fault(world):
+    """A transient at the windowed query's private window-store insert
+    (after the main store committed) must not escape feed() or corrupt
+    window bookkeeping — the epoch commits and the result still matches
+    the surviving-window oracle."""
+    triples, ss, perm = world
+    base, live = split(triples, perm, len(triples) // 2)
+    live = live[:12000]
+    spec = WindowSpec(size=3, slide=1)
+    ctx = StreamContext([build_partition(base, 0, 1)], ss)
+    qid = ctx.register(Q_ONEHOP, window=spec, base_triples=base)
+    # each epoch fires dynamic.insert twice (main store, then window
+    # store); after=1 + every-other targeting hits only window inserts
+    faults.install(FaultPlan([FaultSpec("dynamic.insert", "transient",
+                                        after=1, count=3)], seed=7))
+    batches = [b for _, b in ReplaySource(live, batch_size=2000)]
+    for b in batches:
+        ctx.feed(b)
+    assert faults.active().specs[0].fired == 3
+    assert ctx.epoch == 6
+    oracle = full_run(np.concatenate([base, _surviving(batches, spec)]),
+                      ss, Q_ONEHOP)
+    assert np.array_equal(ctx.result_set(qid), oracle)
+
+
+@pytest.mark.chaos
+def test_non_dedup_ingest_does_not_retry(world):
+    """Without dedup a replayed batch would double-append, so transients
+    surface to the caller instead of being retried."""
+    triples, ss, perm = world
+    base, live = split(triples, perm, 4000)
+    ctx = StreamContext([build_partition(base, 0, 1)], ss, dedup=False)
+    faults.install(FaultPlan([FaultSpec("stream.ingest", "transient",
+                                        count=1)], seed=7))
+    with pytest.raises(TransientFault):
+        ctx.feed(live[:100])
+    assert ctx.epoch == 0  # the failed batch never became an epoch
+    ctx.feed(live[:100])  # next attempt (fault budget spent) commits
+    assert ctx.epoch == 1
+
+
+@pytest.mark.chaos
+def test_persistent_ingest_fault_exhausts_retries(world):
+    triples, ss, perm = world
+    base, live = split(triples, perm, 4000)
+    ctx = StreamContext([build_partition(base, 0, 1)], ss)
+    faults.install(FaultPlan([FaultSpec("stream.ingest", "transient")],
+                             seed=7))
+    with pytest.raises(RetryExhausted):
+        ctx.feed(live[:100])
+    assert ctx.epoch == 0
+
+
+def test_ingest_rejects_negative_ids(world):
+    ctx = _ctx(world)
+    with pytest.raises(WukongError):
+        ctx.feed(np.asarray([[-1, 1, 5]], dtype=np.int64))
+    with pytest.raises(WukongError):
+        ctx.feed(np.arange(8, dtype=np.int64).reshape(2, 4))
+
+
+# ---------------------------------------------------------------------------
+# runtime integration: stream lane, proxy verbs, monitor
+# ---------------------------------------------------------------------------
+
+def test_stream_lane_matches_inline(world):
+    """Delta queries routed through the engine pool's low-priority stream
+    lane produce the identical standing result, while the pool keeps
+    serving interactive queries."""
+    triples, ss, perm = world
+    base, live = split(triples, perm, len(triples) // 2)
+    g = build_partition(base, 0, 1)
+    pool = EnginePool(num_engines=2,
+                      make_engine=lambda tid: CPUEngine(g, ss))
+    pool.start()
+    try:
+        ctx = StreamContext([g], ss, pool=pool)
+        qid = ctx.register(Q_CHAIN)
+        for _, batch in ReplaySource(live, batch_size=8192):
+            ctx.feed(batch)
+        # interactive one-shot rides the same pool, default lane
+        q = Parser(ss).parse(Q_ONEHOP)
+        heuristic_plan(q)
+        q.result.blind = True
+        out = pool.wait(pool.submit(q), timeout=60)
+        assert out.result.status_code == ErrorCode.SUCCESS
+        assert np.array_equal(ctx.result_set(qid),
+                              full_run(triples, ss, Q_CHAIN))
+    finally:
+        pool.stop()
+
+
+def test_inline_eval_crash_degrades_not_escapes(world):
+    """An engine crash during one standing query's inline delta eval must
+    not escape feed() (the main store already committed) or starve the
+    other registered queries."""
+    triples, ss, perm = world
+    base, live = split(triples, perm, len(triples) // 2)
+    ctx = StreamContext([build_partition(base, 0, 1)], ss)
+    q1 = ctx.register(Q_ONEHOP)
+    q2 = ctx.register(Q_CHAIN)
+    real = ctx.continuous.engine.execute
+    calls = {"n": 0}
+
+    def boom(q, from_proxy=True):
+        calls["n"] += 1
+        if calls["n"] == 1:  # q1's first term of the first epoch
+            raise RuntimeError("injected eval crash")
+        return real(q, from_proxy=from_proxy)
+
+    ctx.continuous.engine.execute = boom
+    recs = ctx.feed_source(ReplaySource(live, batch_size=8192))
+    assert [r.epoch for r in recs] == list(range(1, len(recs) + 1))
+    assert ctx.continuous.queries[q1].degraded_epochs == 1
+    assert ctx.continuous.queries[q2].degraded_epochs == 0
+    # the unaffected query still matches the oracle exactly
+    assert np.array_equal(ctx.result_set(q2), full_run(triples, ss, Q_CHAIN))
+
+
+def test_stream_lane_starvation_bounded_wait(world, monkeypatch):
+    """A starved stream lane must not block feed() forever: the wait is
+    bounded, the epoch degrades, and the abandoned completion is reaped on
+    a later epoch instead of leaking."""
+    import time
+
+    import wukong_tpu.stream.continuous as cont
+
+    triples, ss, perm = world
+    base, live = split(triples, perm, 4000)
+    g = build_partition(base, 0, 1)
+
+    class Slow:
+        def __init__(self):
+            self.inner = CPUEngine(g, ss)
+
+        def execute(self, q):
+            time.sleep(0.3)
+            return self.inner.execute(q)
+
+    monkeypatch.setattr(cont, "STREAM_WAIT_TIMEOUT_S", 0.01)
+    pool = EnginePool(num_engines=1, make_engine=lambda tid: Slow())
+    pool.start()
+    try:
+        ctx = StreamContext([g], ss, pool=pool)
+        qid = ctx.register(Q_ONEHOP)
+        rec = ctx.feed(live[:500])  # returns despite the slow engine
+        assert rec.epoch == 1
+        assert ctx.continuous.queries[qid].degraded_epochs == 1
+        assert len(ctx.continuous._abandoned) == 1
+        time.sleep(0.5)  # let the slow execution finish
+        ctx.feed(np.empty((0, 3), dtype=np.int64))  # reaps on next epoch
+        assert ctx.continuous._abandoned == []
+    finally:
+        pool.stop()
+
+
+def test_stream_lane_completions_skip_poll():
+    """poll() (the emulator's open-loop receive side) must never consume
+    stream-lane completions — they stay claimable by the stream context's
+    wait() even when both share one pool."""
+    import time
+
+    class Echo:
+        def execute(self, q):
+            return q
+
+    pool = EnginePool(num_engines=1, make_engine=lambda tid: Echo())
+    pool.start()
+    try:
+        q = type("Q", (), {"deadline": None})()
+        h = pool.submit(q, lane="stream")
+        deadline = time.time() + 10
+        while not pool._done[h].is_set() and time.time() < deadline:
+            time.sleep(0.005)
+        drained = pool.poll()
+        assert all(qid != h for qid, _ in drained)
+        assert pool.wait(h, timeout=10) is q
+    finally:
+        pool.stop()
+
+
+def test_proxy_stream_verbs(world):
+    triples, ss, perm = world
+    base, live = split(triples, perm, len(triples) // 2)
+    proxy = Proxy(build_partition(base, 0, 1), ss)
+    qid = proxy.stream_register(Q_CONST)
+    for _, batch in ReplaySource(live, batch_size=8192):
+        proxy.stream_feed(batch)
+    deltas = proxy.stream_poll(qid)
+    assert all(d.sign == +1 for d in deltas)
+    got = proxy.stream_context().result_set(qid)
+    assert np.array_equal(got, full_run(triples, ss, Q_CONST))
+    # monitor saw every epoch
+    stats = proxy.monitor.stream_stats()
+    assert stats["epochs"] == proxy.stream_context().epoch
+    assert stats["triples"] == len(live)
+    assert stats["lag_us_cdf"]  # populated CDF
+    proxy.stream_unregister(qid)
+    with pytest.raises(WukongError):
+        proxy.stream_poll(qid)
+
+
+def test_monitor_share_observability():
+    """The emulator's per-run monitor adopts the proxy monitor's stream
+    stats + breakers, so epochs recorded proxy-side are visible to the
+    rolling-report printer."""
+    shared, private = Monitor(), Monitor()
+    private.share_observability(shared)
+    shared.record_stream_epoch(n_triples=10, ingest_us=5, eval_us=7,
+                               lag_us=12)
+    assert private.stream_stats()["epochs"] == 1
+    br = CircuitBreaker(threshold=1, cooldown_ms=1000, clock=lambda: 0.0)
+    shared.attach_breaker("dist.shard", br)
+    br.record_failure(0)
+    assert private.breaker_report()  # visible through the adopted registry
+    # per-query counters stay private
+    shared.add_latency(100)
+    assert private.cnt == 0
+
+
+def test_monitor_breaker_surface():
+    clock = [0.0]
+    br = CircuitBreaker(threshold=2, cooldown_ms=1000,
+                        clock=lambda: clock[0])
+    mon = Monitor()
+    mon.attach_breaker("dist.shard", br)
+    assert mon.breaker_report() == []  # no tracked keys yet
+    br.record_success(0)
+    br.record_failure(1)
+    br.record_failure(1)  # trips shard 1
+    clock[0] = 0.5
+    s = mon.breaker_summary()["dist.shard"]
+    assert (s["closed"], s["open"], s["half_open"]) == (1, 1, 0)
+    assert s["last_trip_age_s"] == pytest.approx(0.5)
+    [line] = mon.breaker_report()
+    assert "1 closed" in line and "1 open" in line and "last trip" in line
+    clock[0] = 2.0  # past cooldown: the tripped key is probe-able
+    assert mon.breaker_summary()["dist.shard"]["half_open"] == 1

@@ -384,6 +384,18 @@ def _emit_kernel(edges_ref, dsel_ref, dpar_ref,
         total_out[0, 0] = blk * T + f_end
 
 
+def _tpu_compiler_params(pltpu):
+    """Sequential-grid + side-effect compiler params across the pallas API
+    rename: ``CompilerParams`` (with ``has_side_effects``) is jax >= 0.5;
+    0.4.x only has ``TPUCompilerParams`` without the flag — safe to drop
+    there because every kernel's outputs are consumed by the caller, so the
+    call is never DCE'd."""
+    cls = getattr(pltpu, "CompilerParams", None)
+    if cls is not None:
+        return cls(dimension_semantics=("arbitrary",), has_side_effects=True)
+    return pltpu.TPUCompilerParams(dimension_semantics=("arbitrary",))
+
+
 def _stream_emit(edges2, dsel2, dpar2, cap_out: int, interpret: bool = False,
                  mxu: bool | None = None):
     """pallas_call wrapper: edges2/dsel2/dpar2 are [G, TILE]; returns
@@ -430,10 +442,7 @@ def _stream_emit(edges2, dsel2, dpar2, cap_out: int, interpret: bool = False,
             pltpu.SemaphoreType.DMA((2, 2)),
             pltpu.SMEM((8,), jnp.int32),
         ],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("arbitrary",),
-            has_side_effects=True,
-        ),
+        compiler_params=_tpu_compiler_params(pltpu),
         interpret=interpret,
     )(edges2, dsel2, dpar2)
     return val.reshape(cap_pad, 1), par.reshape(cap_pad, 1), total
@@ -605,10 +614,7 @@ def _stream_emit_m(edges2, dsel2, drow2, cap_out: int, interpret: bool = False,
             pltpu.SemaphoreType.DMA((2, 2)),
             pltpu.SMEM((8,), jnp.int32),
         ],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("arbitrary",),
-            has_side_effects=True,
-        ),
+        compiler_params=_tpu_compiler_params(pltpu),
         interpret=interpret,
     )(edges2, dsel2, drow2)
     return val.reshape(cap_pad, 1), rowpos.reshape(cap_pad, 1), total

@@ -18,6 +18,10 @@ Sites instrumented today:
 - ``dist.chain_dispatch`` — compiled-chain dispatch in parallel/dist_engine.py
 - ``hdfs.read``         — HDFS CLI invocations in loader/hdfs.py
 - ``pool.execute``      — per-query execution in runtime/scheduler.py
+- ``dynamic.insert``    — online batch insert in store/dynamic.py
+  (``shard`` = partition sid; fires before any mutation, so retries are safe)
+- ``stream.ingest``     — per-epoch commit in stream/ingest.py (retried with
+  backoff when dedup makes the batch idempotent)
 
 When no plan is installed every hook is a cheap no-op.
 """

@@ -3,16 +3,50 @@
 Per-query latency records keyed by query id, rolling throughput reporting, and
 per-query-type latency vectors aggregated into a CDF — the same measurements the
 reference's proxy prints during `sparql -n N` and `sparql-emu` runs.
+
+Beyond the reference: streaming metrics (per-epoch ingest/eval latency and
+commit-to-results lag, fed by stream/ingest.py) and per-shard circuit-breaker
+state (attached CircuitBreakers from the resilience layer), both folded into
+the rolling throughput report.
 """
 
 from __future__ import annotations
 
-from collections import defaultdict
+from collections import defaultdict, deque
 
 import numpy as np
 
 from wukong_tpu.utils.logger import log_info
 from wukong_tpu.utils.timer import get_usec
+
+# per-epoch latency samples kept for the stream CDF (bounds memory on
+# long-running ingest loops; the totals keep counting past it)
+STREAM_WINDOW = 4096
+
+
+def _cdf(vals, points=(0.5, 0.9, 0.95, 0.99, 1.0)) -> dict[float, float]:
+    """Percentile dict over a sample list/deque (monitor.hpp print_cdf
+    indexing — shared by the query and stream CDFs)."""
+    if not vals:
+        return {}
+    arr = np.sort(np.asarray(vals, dtype=np.float64))
+    return {p: float(arr[min(int(p * len(arr)), len(arr) - 1)])
+            for p in points}
+
+
+class StreamStats:
+    """Streaming counters + latency windows, shareable between monitors
+    (the emulator's per-run Monitor adopts the proxy monitor's instance so
+    its rolling report sees epochs committed on the proxy side)."""
+
+    __slots__ = ("epochs", "triples", "lag_us", "eval_us", "ingest_us")
+
+    def __init__(self):
+        self.epochs = 0
+        self.triples = 0
+        self.lag_us: deque = deque(maxlen=STREAM_WINDOW)
+        self.eval_us: deque = deque(maxlen=STREAM_WINDOW)
+        self.ingest_us: deque = deque(maxlen=STREAM_WINDOW)
 
 
 class Monitor:
@@ -23,6 +57,25 @@ class Monitor:
         self._t0 = None
         self._last_print = None
         self._last_cnt = 0
+        # -- streaming (stream/ingest.py feeds record_stream_epoch) --------
+        self.stream = StreamStats()
+        self._last_stream_epochs = 0
+        self._last_stream_triples = 0
+        # -- circuit breakers (name -> CircuitBreaker) ---------------------
+        self._breakers: dict[str, object] = {}
+
+    def share_observability(self, other: "Monitor") -> None:
+        """Adopt ``other``'s stream stats and breaker registry by reference,
+        keeping per-query counters (and the rolling-print cursor) private.
+        The emulator's per-run Monitor does this against the proxy monitor
+        so breaker/stream lines reach the only rolling-report printer."""
+        self.stream = other.stream
+        self._breakers = other._breakers
+        # start the print cursors at the adopted totals — epochs committed
+        # before this monitor existed must not read as rate in its first
+        # report window
+        self._last_stream_epochs = other.stream.epochs
+        self._last_stream_triples = other.stream.triples
 
     # -- per-query records (monitor.hpp start_record/end_record) ----------
     def start_record(self, qid: int, qtype: int = 0) -> None:
@@ -50,6 +103,18 @@ class Monitor:
         if self._last_print is not None and now - self._last_print > interval_usec:
             d = now - self._last_print
             log_info(f"Throughput: {(self.cnt - self._last_cnt) / (d / 1e6):,.0f} q/s")
+            if self.stream.epochs > self._last_stream_epochs:
+                de = self.stream.epochs - self._last_stream_epochs
+                dt = self.stream.triples - self._last_stream_triples
+                lag = self.stream_lag_cdf()
+                lag_str = (f", lag p50={lag[0.5]:,.0f}us "
+                           f"p99={lag[0.99]:,.0f}us" if lag else "")
+                log_info(f"Stream: {de / (d / 1e6):,.1f} epochs/s, "
+                         f"{dt / (d / 1e6):,.0f} triples/s{lag_str}")
+            self._last_stream_epochs = self.stream.epochs
+            self._last_stream_triples = self.stream.triples
+            for line in self.breaker_report():
+                log_info(line)
             self._last_print = now
             self._last_cnt = self.cnt
 
@@ -58,6 +123,67 @@ class Monitor:
             return 0.0
         dt = get_usec() - self._t0
         return self.cnt / (dt / 1e6) if dt else 0.0
+
+    # -- streaming metrics (no reference analogue; Wukong+S-style lag) -----
+    def record_stream_epoch(self, n_triples: int, ingest_us: int,
+                            eval_us: int, lag_us: int) -> None:
+        """One committed epoch: batch size, insert time, standing-query
+        evaluation time, and commit-to-results lag."""
+        self.stream.epochs += 1
+        self.stream.triples += int(n_triples)
+        self.stream.ingest_us.append(int(ingest_us))
+        self.stream.eval_us.append(int(eval_us))
+        self.stream.lag_us.append(int(lag_us))
+
+    def stream_lag_cdf(self, points=(0.5, 0.9, 0.95, 0.99, 1.0)):
+        return _cdf(self.stream.lag_us, points)
+
+    def stream_stats(self) -> dict:
+        """Aggregate streaming view (bench_stream.py's artifact source)."""
+        return {
+            "epochs": self.stream.epochs,
+            "triples": self.stream.triples,
+            "ingest_us_cdf": _cdf(self.stream.ingest_us),
+            "eval_us_cdf": _cdf(self.stream.eval_us),
+            "lag_us_cdf": self.stream_lag_cdf(),
+        }
+
+    # -- circuit breakers (resilience satellite: PR 1 follow-up) -----------
+    def attach_breaker(self, name: str, breaker) -> None:
+        """Register a CircuitBreaker for state surfacing (e.g. the sharded
+        store's per-shard breaker). Idempotent by name."""
+        self._breakers[name] = breaker
+
+    def breaker_summary(self) -> dict[str, dict]:
+        """name -> {counts by state, last_trip_age_s (most recent across
+        keys, None = never)}."""
+        out = {}
+        for name, br in self._breakers.items():
+            snap = br.snapshot()
+            counts = {"closed": 0, "open": 0, "half_open": 0}
+            last_trip = None
+            for st in snap.values():
+                counts[st["state"]] += 1
+                age = st["last_trip_age_s"]
+                if age is not None and (last_trip is None or age < last_trip):
+                    last_trip = age
+            out[name] = {**counts, "last_trip_age_s": last_trip}
+        return out
+
+    def breaker_report(self) -> list[str]:
+        """Rolling-report lines — only breakers with any tracked key, and
+        trip info only when something actually tripped."""
+        lines = []
+        for name, s in self.breaker_summary().items():
+            total = s["closed"] + s["open"] + s["half_open"]
+            if total == 0:
+                continue
+            line = (f"Breaker[{name}]: {s['closed']} closed, "
+                    f"{s['open']} open, {s['half_open']} half-open")
+            if s["last_trip_age_s"] is not None:
+                line += f" (last trip {s['last_trip_age_s']:.1f}s ago)"
+            lines.append(line)
+        return lines
 
     # -- CDF (monitor.hpp print_cdf) ---------------------------------------
     def cdf(self, qtype: int | None = None,
@@ -68,10 +194,7 @@ class Monitor:
                 vals.extend(v)
         else:
             vals = list(self.latencies.get(qtype, []))
-        if not vals:
-            return {}
-        arr = np.sort(np.asarray(vals, dtype=np.float64))
-        return {p: float(arr[min(int(p * len(arr)), len(arr) - 1)]) for p in points}
+        return _cdf(vals, points)
 
     def print_cdf(self, labels: dict[int, str] | None = None) -> None:
         """Per-class latency CDF. `labels` marks how a class was measured —

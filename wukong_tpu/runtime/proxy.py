@@ -6,6 +6,9 @@ Glues parser -> planner -> engine and implements the reference's query modes:
 - run_query_emu: open-loop throughput emulator over template mixes with
   candidate filling (proxy.hpp:69-129, 391-545) — see emulator.py
 - dynamic_load_data / gstore_check passthroughs (proxy.hpp:548-597)
+- streaming verbs (no reference analogue — Wukong+S): stream_register /
+  stream_unregister / stream_poll for standing queries, stream_feed for
+  epoch commits (see wukong_tpu/stream/)
 """
 
 from __future__ import annotations
@@ -36,6 +39,12 @@ class Proxy:
         self.planner = planner  # cost-based optimizer (optional)
         self.monitor = Monitor()
         self._pool = None
+        self._stream = None
+        # surface the sharded store's per-shard breaker in the rolling
+        # throughput report (resilience observability, PR 1 follow-up)
+        breaker = getattr(getattr(dist_engine, "sstore", None), "breaker", None)
+        if breaker is not None:
+            self.monitor.attach_breaker("dist.shard", breaker)
 
     def engine_pool(self):
         """Lazily-started host engine pool (N CPU engines with stealing and
@@ -208,14 +217,71 @@ class Proxy:
         from wukong_tpu.store.dynamic import load_dir_into
 
         dirname = resolve_dataset_dir(dirname)  # hdfs:// paths stage locally
+        n = load_dir_into(self._insert_targets(), dirname, dedup=check_dup)
+        if self.dist is not None and self.dist.sstore.check_version():
+            # compiled chains bake per-segment probe/depth bounds
+            self._fn_cache_clear()
+        log_info(f"dynamic load: {n:,} new subject-side edges from {dirname}")
+
+    # ------------------------------------------------------------------
+    # streaming verbs (Wukong+S surface; wukong_tpu/stream/)
+    # ------------------------------------------------------------------
+    def stream_context(self, use_pool: bool = False):
+        """Lazily-assembled StreamContext over this proxy's store(s).
+
+        Inserts reach the host store and every distributed shard (like
+        `load -d`); delta evaluation runs on the host partition. With
+        use_pool the delta queries ride the engine pool's stream lane,
+        interleaving with one-shot queries. The flag only matters on first
+        call — the context is built once.
+        """
+        if self._stream is None:
+            from wukong_tpu.stream import StreamContext
+
+            self._stream = StreamContext(
+                self._insert_targets(), self.str_server,
+                pool=self.engine_pool() if use_pool else None,
+                monitor=self.monitor)
+        return self._stream
+
+    def _insert_targets(self) -> list:
+        """Every store online inserts must reach: the host partition first,
+        then the distributed shards (the `load -d` fan-out)."""
         targets = [self.g]
         if self.dist is not None:
             targets += [g for g in self.dist.sstore.stores if g is not self.g]
-        n = load_dir_into(targets, dirname, dedup=check_dup)
+        return targets
+
+    def stream_register(self, text: str, window=None, base_triples=None) -> int:
+        """Register a standing SPARQL query; returns its stream qid."""
+        return self.stream_context().register(text, window=window,
+                                              base_triples=base_triples)
+
+    def stream_unregister(self, qid: int) -> None:
+        self.stream_context().unregister(qid)
+
+    def stream_poll(self, qid: int, since_epoch: int = -1) -> list:
+        """Read a standing query's append-only result deltas."""
+        return self.stream_context().poll(qid, since_epoch)
+
+    def stream_prune(self, qid: int, upto_epoch: int) -> int:
+        """Free a standing query's consumed sink history behind a cursor."""
+        return self.stream_context().prune(qid, upto_epoch)
+
+    def stream_feed(self, triples, ts=None):
+        """Commit one triple batch as the next stream epoch; standing
+        queries are incrementally evaluated before this returns. Device
+        caches restage lazily via the store version bump, and compiled
+        distributed chains are re-specialized like dynamic_load_data."""
+        rec = self.stream_context().feed(triples, ts=ts)
         if self.dist is not None and self.dist.sstore.check_version():
-            # compiled chains bake per-segment probe/depth bounds
-            self.dist._fn_cache.clear()
-        log_info(f"dynamic load: {n:,} new subject-side edges from {dirname}")
+            self._fn_cache_clear()
+        return rec
+
+    def _fn_cache_clear(self) -> None:
+        cache = getattr(self.dist, "_fn_cache", None)
+        if cache is not None:
+            cache.clear()
 
     def gstore_check(self, index_check: bool = True, normal_check: bool = True) -> int:
         from wukong_tpu.store.checker import check_partition

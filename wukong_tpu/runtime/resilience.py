@@ -211,18 +211,26 @@ class CircuitBreaker:
         self._lock = threading.Lock()
         # key -> [consecutive_failures, opened_at | None, half_open_inflight]
         self._st: dict = {}
+        # key -> clock time of the most recent open/reopen (trip); survives
+        # the breaker closing again, so operators can see flap history
+        self._last_trip: dict = {}
 
     def _slot(self, key):
         return self._st.setdefault(key, [0, None, False])
 
+    def _state_of(self, slot, now: float) -> str:
+        """Classify one slot; caller holds the lock (state machine lives
+        here once — state() and snapshot() must never disagree)."""
+        fails, opened_at, half = slot
+        if opened_at is None:
+            return "closed"
+        if half or now - opened_at >= self.cooldown_s:
+            return "half_open"
+        return "open"
+
     def state(self, key) -> str:
         with self._lock:
-            fails, opened_at, half = self._slot(key)
-            if opened_at is None:
-                return "closed"
-            if half or self._clock() - opened_at >= self.cooldown_s:
-                return "half_open"
-            return "open"
+            return self._state_of(self._slot(key), self._clock())
 
     def allow(self, key) -> bool:
         """True when a call may proceed. The transition to half-open admits
@@ -259,9 +267,11 @@ class CircuitBreaker:
                 # failed half-open trial (or failure while open): reopen
                 slot[1] = self._clock()
                 slot[2] = False
+                self._last_trip[key] = slot[1]
             elif slot[0] >= self.threshold:
                 slot[1] = self._clock()
                 slot[2] = False
+                self._last_trip[key] = slot[1]
 
     def tripped(self, key) -> bool:
         return self.state(key) != "closed"
@@ -270,3 +280,18 @@ class CircuitBreaker:
         with self._lock:
             now = self._clock()
             return [k for k, (f, o, h) in self._st.items() if o is not None]
+
+    def snapshot(self) -> dict:
+        """Per-key observability view: state, consecutive failures, and age
+        of the most recent trip (None = never tripped). The Monitor prints
+        this in the rolling throughput report."""
+        with self._lock:
+            now = self._clock()
+            out = {}
+            for k, slot in self._st.items():
+                trip = self._last_trip.get(k)
+                out[k] = {"state": self._state_of(slot, now),
+                          "consecutive_failures": slot[0],
+                          "last_trip_age_s":
+                              (now - trip) if trip is not None else None}
+            return out

@@ -8,6 +8,18 @@ reproduces that runtime structure for the host-side engines: inter-query
 parallelism across a thread pool (numpy/JAX release the GIL on the heavy ops),
 deque-based queues stolen from the back, and the same pair/ring neighbor
 selection.
+
+Beyond the reference: a shared low-priority *stream lane*
+(``submit(q, lane="stream")``) for standing-query delta work
+(stream/continuous.py). Engines drain it only after their own queue and
+their steal targets are empty, so interactive one-shot queries always go
+first and continuous evaluation soaks up the idle capacity — under the same
+per-query deadline/budget machinery (expired stream items are shed from the
+queue exactly like interactive ones). Stream-lane completions are reserved
+for wait() and never returned by poll(), so an open-loop poll() consumer
+(the emulator) can share the pool with the stream context without racing
+its completions; the one-consumer discipline (wait XOR poll) still applies
+among default-lane users.
 """
 
 from __future__ import annotations
@@ -50,6 +62,13 @@ class EnginePool:
         self._route_lock = threading.Lock()
         self._busy_since = [0] * self.n  # usec; 0 = idle (health() surface)
         self._inflight: list = [None] * self.n  # (qid, query) being executed
+        # stream lane: shared low-priority queue for standing-query work
+        self.stream_queue = collections.deque()
+        self._stream_lock = threading.Lock()
+        # stream-lane qids are reserved for wait(): poll() skips them, so
+        # an open-loop poll() consumer (the emulator) sharing this pool
+        # can't steal the stream context's completions
+        self._stream_qids: set = set()
 
     # ------------------------------------------------------------------
     def start(self) -> None:
@@ -135,15 +154,38 @@ class EnginePool:
                 with self.locks[dst]:
                     self.queues[dst].append(item)
                 self._pending.release()
+            if not live:  # nobody left to drain the stream lane either
+                with self._stream_lock:
+                    stream_stranded = list(self.stream_queue)
+                    self.stream_queue.clear()
+                for item in stream_stranded:
+                    self._fail(item[0], RuntimeError("engine pool dead"))
 
     # ------------------------------------------------------------------
-    def submit(self, query, tid: int | None = None) -> int:
+    def submit(self, query, tid: int | None = None,
+               lane: str | None = None) -> int:
         """Enqueue a query; returns a handle. tid routes like the reference's
-        proxy dst engine choice (round-robin default, proxy.hpp:143-160)."""
+        proxy dst engine choice (round-robin default, proxy.hpp:143-160).
+
+        lane="stream" bypasses per-engine routing into the shared
+        low-priority stream queue: any engine drains it, but only after its
+        own queue and its steal targets are empty (standing-query work never
+        displaces interactive queries)."""
         with self._results_lock:
             qid = self._next_qid
             self._next_qid += 1
             self._done[qid] = threading.Event()
+        if lane == "stream":
+            with self._results_lock:
+                self._stream_qids.add(qid)
+            with self._route_lock:
+                if all(self._dead[k] for k in range(self.n)):
+                    self._fail(qid, RuntimeError("engine pool dead"))
+                    return qid
+                with self._stream_lock:
+                    self.stream_queue.append((qid, query))
+            self._pending.release()
+            return qid
         t = qid % self.n if tid is None else tid % self.n
         with self._route_lock:  # atomic dead-check + enqueue vs declare-dead
             if self._dead[t]:  # route around dead engines
@@ -164,6 +206,7 @@ class EnginePool:
             raise TimeoutError(f"query {qid} still running")
         with self._results_lock:
             self._done.pop(qid, None)
+            self._stream_qids.discard(qid)
             try:
                 self._completed.remove(qid)
             except ValueError:
@@ -182,6 +225,10 @@ class EnginePool:
                 break
             with self._results_lock:
                 if qid not in self._done:  # already consumed via wait()
+                    continue
+                if qid in self._stream_qids:
+                    # stream-lane completions belong to the stream
+                    # context's wait() — leave them claimable
                     continue
                 self._done.pop(qid)
                 out.append((qid, self._results.pop(qid, None)))
@@ -206,6 +253,10 @@ class EnginePool:
             with self.locks[nb]:
                 if self.queues[nb]:
                     return self.queues[nb].pop()
+        # stream lane last: standing-query work fills idle capacity only
+        with self._stream_lock:
+            if self.stream_queue:
+                return self.stream_queue.popleft()
         return None
 
     def _run_engine(self, tid: int) -> None:

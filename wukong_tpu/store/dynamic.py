@@ -125,6 +125,11 @@ def insert_triples(g: GStore, triples: np.ndarray, dedup: bool = True,
 
     Bumps g.version so device caches restage affected segments.
     """
+    from wukong_tpu.runtime import faults
+
+    # fault hook BEFORE any mutation: an injected transient leaves the store
+    # untouched, so the ingest path's retry replays the batch safely
+    faults.site("dynamic.insert", shard=g.sid)
     if check_ids:
         from wukong_tpu.store.gstore import check_vid_range
 

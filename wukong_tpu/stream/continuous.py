@@ -1,0 +1,498 @@
+"""Continuous SPARQL: standing queries evaluated incrementally per epoch.
+
+The Wukong+S core (SOSP'17): a registered BGP query is not re-run from
+scratch when new triples arrive — each ingest epoch is evaluated
+*semi-naively*. For a query with patterns P1..Pn and an epoch delta D
+(the batch's new triples), the new results are exactly
+
+    union over i of  eval(P1..Pi-1, Pi|D, Pi+1..Pn)  against the merged graph
+
+because every new result uses at least one new triple, and the term that
+pins pattern i to D covers all results whose (lexicographically first) new
+triple matches Pi. Each term is executed by seeding the binding table with
+Pi's matches in D — the *frontier* — and running the remaining patterns
+through the ordinary engine kernels (known_to_unknown & friends) against
+the merged CSR, exactly the delta-join shape GPU Datalog engines use for
+semi-naive iteration (arXiv:2501.13051, arXiv:2604.20073). Terms are
+planned ONCE at registration (the heuristic planner's ``seed_known`` mode
+orders the remaining patterns off the frontier bindings); per epoch only
+the seed tables change.
+
+Results are maintained as a set of projected rows; per-epoch additions are
+emitted to an append-only per-query sink (:class:`ResultDelta`). Windowed
+queries (windows.py) evaluate against a private window store and emit
+retraction deltas when epochs retire.
+
+Supported standing-query shapes: BGPs with FILTERs, DISTINCT-style set
+semantics, const/var subjects and objects, type patterns. Rejected at
+registration (structured errors, never silent wrong answers): UNION,
+OPTIONAL, variable predicates, attribute patterns, ORDER/LIMIT/OFFSET,
+cartesian (disconnected) products.
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from wukong_tpu.config import Global
+from wukong_tpu.planner.heuristic import heuristic_plan, plan_seeded_group
+from wukong_tpu.sparql.ir import NO_RESULT, Pattern, PatternGroup, SPARQLQuery
+from wukong_tpu.types import IN, AttrType
+from wukong_tpu.utils.errors import ErrorCode, WukongError, assert_ec
+from wukong_tpu.utils.logger import log_warn
+from wukong_tpu.utils.timer import get_usec
+
+# bound on waiting for a stream-lane delta term when the deadline knob is
+# off — the lane is strictly lowest-priority, so a saturated pool could
+# otherwise block the feed forever
+STREAM_WAIT_TIMEOUT_S = 60.0
+
+
+@dataclass
+class ResultDelta:
+    """One sink entry: rows added (sign=+1) or retracted (sign=-1) at epoch."""
+
+    epoch: int
+    sign: int
+    rows: np.ndarray  # [k, len(required_vars)], row-sorted
+
+    def __repr__(self):
+        s = "+" if self.sign > 0 else "-"
+        return f"ResultDelta(epoch={self.epoch}, {s}{len(self.rows)} rows)"
+
+
+def _triplewise(pat: Pattern) -> tuple[int, int, int]:
+    """(s, p, o) in *triple* terms: a direction-IN pattern walks in-edges of
+    its subject slot, i.e. the stored triple is (object, p, subject)."""
+    if pat.direction == IN:
+        return pat.object, pat.predicate, pat.subject
+    return pat.subject, pat.predicate, pat.object
+
+
+def match_delta(pat: Pattern, triples: np.ndarray):
+    """Frontier of one pattern over an epoch batch: (vars, seed_table).
+
+    vars lists the pattern's variable endpoints (triple order, deduped);
+    seed_table is the [k, len(vars)] distinct bindings drawn from the batch
+    rows matching the pattern's constants. Empty batch -> (vars, 0-row).
+    """
+    ts, tp, to = _triplewise(pat)
+    s, p, o = triples[:, 0], triples[:, 1], triples[:, 2]
+    mask = p == tp
+    cols = []
+    vars_: list[int] = []
+    for end, col in ((ts, s), (to, o)):
+        if end >= 0:
+            mask = mask & (col == end)
+        elif end in vars_:  # repeated var (?x p ?x): equality, one column
+            mask = mask & (s == o)
+        else:
+            vars_.append(end)
+            cols.append(col)
+    if not vars_:
+        # fully-const pattern: no frontier bindings to seed (rejected at
+        # registration for standing queries)
+        return vars_, np.empty((0, 0), dtype=np.int64)
+    seed = np.stack([c[mask] for c in cols], axis=1).astype(np.int64)
+    if len(seed):
+        seed = np.unique(seed, axis=0)
+    return vars_, seed
+
+
+def _pattern_vars(patterns: list[Pattern]) -> set[int]:
+    return {v for p in patterns for v in (p.subject, p.object) if v < 0}
+
+
+@dataclass
+class StandingQuery:
+    qid: int
+    proto: SPARQLQuery  # pristine parsed (unplanned) query, for refreshes
+    text: str | None
+    patterns: list  # parsed patterns, triple-wise orientation
+    required_vars: list
+    nvars: int
+    term_plans: list  # term_plans[i] = planned remaining patterns for term i
+    window: object = None  # EpochWindow | None
+    wstore: object = None  # private window store (windowed queries only)
+    base_triples: object = None  # static base included in window rebuilds
+    seen: set = field(default_factory=set)
+    sink: list = field(default_factory=list)  # list[ResultDelta]
+    epochs_evaluated: int = 0
+    degraded_epochs: int = 0  # epochs where >=1 term failed (missed results)
+    last_eval_us: int = 0
+
+    def result_set(self) -> np.ndarray:
+        """Current standing result: row-sorted distinct projected rows."""
+        if not self.seen:
+            return np.empty((0, len(self.required_vars)), dtype=np.int64)
+        return np.asarray(sorted(self.seen), dtype=np.int64)
+
+
+class ContinuousEngine:
+    """Standing-query registry + per-epoch semi-naive evaluator.
+
+    ``engine`` executes delta queries inline (default: a CPUEngine over
+    ``gstore``); ``pool`` routes them through the host engine pool's stream
+    lane instead (scheduler.py), interleaving with one-shot queries under
+    the same deadline/budget machinery.
+    """
+
+    def __init__(self, gstore, str_server=None, engine=None, pool=None,
+                 monitor=None):
+        self.g = gstore
+        self.str_server = str_server
+        if engine is None:
+            from wukong_tpu.engine.cpu import CPUEngine
+
+            engine = CPUEngine(gstore, str_server)
+        self.engine = engine
+        self.pool = pool
+        self.monitor = monitor
+        self.queries: dict[int, StandingQuery] = {}
+        self._next_qid = 0
+        self.last_epoch = 0  # highest epoch evaluated (stamps snapshots)
+        self._abandoned: list = []  # timed-out pool handles to reap later
+
+    def _reap_abandoned(self) -> None:
+        """Discard completions whose wait timed out on an earlier epoch
+        (poll() skips stream-lane qids, so only wait() can free them)."""
+        for h in self._abandoned[:]:
+            try:
+                self.pool.wait(h, timeout=0)
+            except TimeoutError:
+                continue  # still running; try again next epoch
+            self._abandoned.remove(h)
+
+    # ------------------------------------------------------------------
+    # registration
+    # ------------------------------------------------------------------
+    def register(self, query, window=None, base_triples=None) -> int:
+        """Register a standing query (SPARQL text or parsed SPARQLQuery).
+
+        ``window`` (WindowSpec) scopes it to the live epochs only, evaluated
+        against a private window store; ``base_triples`` [N,3] are static
+        triples included in every window rebuild.
+        """
+        text = None
+        if isinstance(query, str):
+            from wukong_tpu.sparql.parser import Parser
+
+            text = query
+            query = Parser(self.str_server).parse(query)
+        self._validate(query)
+        patterns = [copy.copy(p) for p in query.pattern_group.patterns]
+        term_plans = [self._plan_term(patterns, i) for i in range(len(patterns))]
+        # the full-query plan must also exist (window refreshes re-run it)
+        heuristic_plan(copy.deepcopy(query))
+        qid = self._next_qid
+        self._next_qid += 1
+        sq = StandingQuery(
+            qid=qid, proto=copy.deepcopy(query), text=text, patterns=patterns,
+            required_vars=list(query.result.required_vars),
+            nvars=query.result.nvars, term_plans=term_plans)
+        if window is not None:
+            from wukong_tpu.stream.windows import EpochWindow, WindowSpec
+
+            if not isinstance(window, WindowSpec):
+                raise WukongError(ErrorCode.UNSUPPORTED_SHAPE,
+                                  "window must be a WindowSpec")
+            sq.window = EpochWindow(spec=window)
+            if base_triples is not None:
+                sq.base_triples = np.asarray(base_triples, dtype=np.int64)
+            sq.wstore = self._build_window_store(sq)
+        # initial snapshot: results already derivable at registration time
+        # (from the base graph, or base_triples for windowed queries) seed
+        # the standing set — epochs only ever add deltas on top of it
+        self._snapshot(sq, self.last_epoch,
+                       sq.wstore if sq.window is not None else self.g)
+        self.queries[qid] = sq
+        return qid
+
+    def unregister(self, qid: int) -> None:
+        assert_ec(qid in self.queries, ErrorCode.UNKNOWN_SUB,
+                  f"unknown standing query {qid}")
+        del self.queries[qid]
+
+    def poll(self, qid: int, since_epoch: int = -1) -> list[ResultDelta]:
+        """Append-only deltas with epoch > since_epoch (the Wukong+S
+        client-pull surface). The default returns the full history including
+        the registration-time snapshot — which is stamped with the epoch
+        current at registration (0 before any feed), so a cursor of 0 would
+        hide it for early registrants but not late ones."""
+        assert_ec(qid in self.queries, ErrorCode.UNKNOWN_SUB,
+                  f"unknown standing query {qid}")
+        return [d for d in self.queries[qid].sink if d.epoch > since_epoch]
+
+    def result_set(self, qid: int) -> np.ndarray:
+        assert_ec(qid in self.queries, ErrorCode.UNKNOWN_SUB,
+                  f"unknown standing query {qid}")
+        return self.queries[qid].result_set()
+
+    def prune(self, qid: int, upto_epoch: int) -> int:
+        """Free consumed sink history: drop deltas with epoch <= upto_epoch
+        (the client's poll cursor). The standing result set is unaffected —
+        only the replayable history shrinks. Returns entries dropped.
+
+        Sinks are otherwise unbounded (truncating silently would hand late
+        pollers wrong answers), so long-running clients should prune behind
+        their cursor."""
+        assert_ec(qid in self.queries, ErrorCode.UNKNOWN_SUB,
+                  f"unknown standing query {qid}")
+        sq = self.queries[qid]
+        kept = [d for d in sq.sink if d.epoch > upto_epoch]
+        dropped = len(sq.sink) - len(kept)
+        sq.sink = kept
+        return dropped
+
+    def _validate(self, q: SPARQLQuery) -> None:
+        pg = q.pattern_group
+        if pg.unions or pg.optional:
+            raise WukongError(ErrorCode.UNSUPPORTED_SHAPE,
+                              "standing queries support BGP+FILTER only "
+                              "(no UNION/OPTIONAL)")
+        if q.orders or q.limit >= 0 or q.offset > 0:
+            raise WukongError(ErrorCode.UNSUPPORTED_SHAPE,
+                              "ORDER/LIMIT/OFFSET have no incremental "
+                              "semantics; standing results are sets")
+        if not pg.patterns:
+            raise WukongError(ErrorCode.UNKNOWN_PATTERN,
+                              "standing query has no patterns")
+        for p in pg.patterns:
+            if p.predicate < 0:
+                raise WukongError(ErrorCode.UNSUPPORTED_SHAPE,
+                                  "variable-predicate patterns are not "
+                                  "incrementally evaluable here")
+            if p.pred_type != int(AttrType.SID_t):
+                raise WukongError(ErrorCode.UNSUPPORTED_SHAPE,
+                                  "attribute patterns are not supported in "
+                                  "standing queries")
+            if p.subject >= 0 and p.object >= 0:
+                raise WukongError(ErrorCode.UNSUPPORTED_SHAPE,
+                                  "fully-constant pattern has no frontier")
+        missing = [v for v in q.result.required_vars
+                   if v not in _pattern_vars(pg.patterns)]
+        if missing:
+            raise WukongError(ErrorCode.NO_REQUIRED_VAR,
+                              f"projection vars {missing} not bound by the BGP")
+
+    def _plan_term(self, patterns: list[Pattern], i: int) -> list[Pattern]:
+        """Order/orient the remaining patterns of term i off the frontier
+        bindings of pattern i — done once at registration."""
+        seed = {v for v in (_triplewise(patterns[i])[0],
+                            _triplewise(patterns[i])[2]) if v < 0}
+        pg = PatternGroup(
+            patterns=[copy.copy(p) for j, p in enumerate(patterns) if j != i])
+        # plan_seeded_group is THE anchorability test (planner.heuristic):
+        # True plans in place off the frontier bindings (raising
+        # UNKNOWN_PLAN if stuck); False means a disjoint remainder
+        if pg.patterns and not plan_seeded_group(pg, seed):
+            raise WukongError(
+                ErrorCode.UNSUPPORTED_SHAPE,
+                f"pattern {patterns[i]!r} shares no variable with the rest "
+                "of the BGP (cartesian product is not incrementally "
+                "evaluable)")
+        return pg.patterns
+
+    # ------------------------------------------------------------------
+    # per-epoch evaluation
+    # ------------------------------------------------------------------
+    def on_epoch(self, epoch: int, triples: np.ndarray, ts=None) -> int:
+        """Evaluate every standing query against one committed epoch.
+
+        Called by the ingestor AFTER the batch is inserted into the main
+        store. Returns total evaluation microseconds across queries.
+        """
+        self.last_epoch = max(self.last_epoch, int(epoch))
+        total_us = 0
+        for sq in list(self.queries.values()):
+            t0 = get_usec()
+            try:
+                if sq.window is not None:
+                    self._on_epoch_windowed(sq, epoch, triples)
+                else:
+                    self._delta_eval(sq, epoch, triples, self.engine)
+            except Exception as e:
+                # the main store already committed this epoch — one query's
+                # failure must not escape the commit or starve the others.
+                # Its results for this epoch are missing: degraded, never
+                # wrong, and never a poisoned ingest path.
+                sq.degraded_epochs += 1
+                log_warn(f"standing query {sq.qid}: epoch {epoch} "
+                         f"evaluation failed: {e!r}")
+            sq.epochs_evaluated += 1
+            sq.last_eval_us = get_usec() - t0
+            total_us += sq.last_eval_us
+        return total_us
+
+    def _delta_eval(self, sq: StandingQuery, epoch: int, triples: np.ndarray,
+                    engine) -> None:
+        """One semi-naive pass: seed each term's frontier from the batch,
+        run the planned remainder against the merged store, merge new rows."""
+        from wukong_tpu.runtime.resilience import Deadline
+
+        new_rows: set = set()
+        degraded = False
+        jobs = []  # (query, term index)
+        for i, pat in enumerate(sq.patterns):
+            vars_, seed = match_delta(pat, triples)
+            if len(seed) == 0:
+                continue
+            q = self._make_delta_query(sq, i, vars_, seed)
+            q.deadline = Deadline.from_config()
+            jobs.append((q, i))
+        if self.pool is not None and engine is self.engine:
+            self._reap_abandoned()
+            # stream lane: interleave with one-shot queries on the pool.
+            # The wait is bounded — the lane is strictly lowest-priority,
+            # so sustained interactive load could otherwise starve it and
+            # block the feed indefinitely
+            timeout = ((Global.query_deadline_ms / 1e3)
+                       if Global.query_deadline_ms > 0
+                       else STREAM_WAIT_TIMEOUT_S)
+            handles = [(self.pool.submit(q, lane="stream"), i)
+                       for q, i in jobs]
+            outs = []
+            for h, i in handles:
+                try:
+                    outs.append((self.pool.wait(h, timeout=timeout), i))
+                except TimeoutError as e:
+                    # leave the completion claimable and reap it on a later
+                    # epoch; this term's results are missing for this epoch
+                    self._abandoned.append(h)
+                    outs.append((e, i))
+        else:
+            outs = []
+            for q, i in jobs:
+                try:
+                    outs.append((engine.execute(q, from_proxy=False), i))
+                except Exception as e:  # mirror the pool path's contract
+                    outs.append((e, i))
+        for out, i in outs:
+            if isinstance(out, Exception):
+                degraded = True
+                log_warn(f"standing query {sq.qid}: term {i} failed at "
+                         f"epoch {epoch}: {out!r}")
+                continue
+            if out.result.status_code != ErrorCode.SUCCESS:
+                # deadline/budget expiry or engine error: results of this
+                # term are missing for this epoch — degraded, never wrong
+                degraded = True
+                log_warn(f"standing query {sq.qid}: term {i} degraded at "
+                         f"epoch {epoch}: {out.result.status_code.name}")
+                continue
+            try:
+                new_rows |= self._project(out.result, sq.required_vars)
+            except WukongError as e:
+                degraded = True
+                log_warn(f"standing query {sq.qid}: term {i} projection "
+                         f"failed at epoch {epoch}: {e!r}")
+        if degraded:
+            sq.degraded_epochs += 1
+        fresh = new_rows - sq.seen
+        if fresh:
+            sq.seen |= fresh
+            sq.sink.append(ResultDelta(
+                epoch=epoch, sign=+1,
+                rows=np.asarray(sorted(fresh), dtype=np.int64)))
+
+    def _make_delta_query(self, sq: StandingQuery, i: int, vars_: list[int],
+                          seed: np.ndarray) -> SPARQLQuery:
+        q = SPARQLQuery()
+        q.pattern_group = PatternGroup(
+            patterns=list(sq.term_plans[i]),
+            filters=sq.proto.pattern_group.filters)
+        res = q.result
+        res.nvars = sq.nvars
+        for col, v in enumerate(vars_):
+            res.add_var2col(v, col)
+        res.set_table(seed)
+        res.blind = True  # engines skip final-process; we project ourselves
+        return q
+
+    @staticmethod
+    def _project(res, required_vars: list[int]) -> set:
+        cols = [res.var2col(v) for v in required_vars]
+        if any(c == NO_RESULT for c in cols):
+            if res.nrows == 0:
+                return set()
+            raise WukongError(ErrorCode.NO_REQUIRED_VAR,
+                              "standing-query projection var unbound")
+        if res.nrows == 0:
+            return set()
+        return set(map(tuple, res.table[:, cols].tolist()))
+
+    # ------------------------------------------------------------------
+    # windowed queries
+    # ------------------------------------------------------------------
+    def _build_window_store(self, sq: StandingQuery):
+        from wukong_tpu.store.gstore import build_partition
+
+        parts = [sq.window.live_triples()]
+        if sq.base_triples is not None:
+            parts.insert(0, sq.base_triples)
+        triples = np.concatenate(parts) if len(parts) > 1 else parts[0]
+        return build_partition(triples, 0, 1)
+
+    def _on_epoch_windowed(self, sq: StandingQuery, epoch: int,
+                           triples: np.ndarray) -> None:
+        from wukong_tpu.engine.cpu import CPUEngine
+        from wukong_tpu.runtime.resilience import retry_call
+        from wukong_tpu.store.dynamic import insert_triples
+
+        retired = sq.window.add(epoch, triples)
+        if retired:
+            # expiry is not incrementalizable without support counting:
+            # rebuild the window store from the survivors and refresh the
+            # full result set; the diff yields additions AND retractions
+            sq.wstore = self._build_window_store(sq)
+            self._snapshot(sq, epoch, sq.wstore)
+            return
+        try:
+            # the private window-store insert is a dynamic.insert fault
+            # site like the main commit; dedup makes replays idempotent,
+            # so retry the same way
+            retry_call(lambda: insert_triples(sq.wstore, triples,
+                                              dedup=True, check_ids=False),
+                       site="dynamic.insert")
+            self._delta_eval(sq, epoch, triples,
+                             CPUEngine(sq.wstore, self.str_server))
+        except Exception as e:
+            # the main store already committed this epoch — a window-side
+            # failure must not escape and strand half-updated bookkeeping.
+            # Rebuild from the recorded live epochs and diff: a full
+            # refresh, correct but not incremental.
+            log_warn(f"standing query {sq.qid}: windowed epoch {epoch} "
+                     f"degraded to full refresh: {e!r}")
+            sq.wstore = self._build_window_store(sq)
+            self._snapshot(sq, epoch, sq.wstore)
+
+    def _snapshot(self, sq: StandingQuery, epoch: int, store) -> None:
+        """Full (non-incremental) evaluation against ``store``; the diff
+        against the current standing set is emitted as retraction/addition
+        deltas. Used for the registration snapshot and window refreshes."""
+        from wukong_tpu.engine.cpu import CPUEngine
+
+        q = copy.deepcopy(sq.proto)
+        heuristic_plan(q)
+        q.result.blind = True
+        eng = CPUEngine(store, self.str_server)
+        eng.execute(q, from_proxy=False)
+        if q.result.status_code != ErrorCode.SUCCESS:
+            sq.degraded_epochs += 1
+            log_warn(f"standing query {sq.qid}: snapshot degraded at "
+                     f"epoch {epoch}: {q.result.status_code.name}")
+            return
+        now = self._project(q.result, sq.required_vars)
+        gone, fresh = sq.seen - now, now - sq.seen
+        if gone:
+            sq.sink.append(ResultDelta(
+                epoch=epoch, sign=-1,
+                rows=np.asarray(sorted(gone), dtype=np.int64)))
+        if fresh:
+            sq.sink.append(ResultDelta(
+                epoch=epoch, sign=+1,
+                rows=np.asarray(sorted(fresh), dtype=np.int64)))
+        sq.seen = now

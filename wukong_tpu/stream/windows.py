@@ -1,0 +1,84 @@
+"""Epoch windows for standing queries (the Wukong+S window layer).
+
+Wukong+S (SOSP'17) evaluates continuous queries over a bounded suffix of the
+stream; expired data is retired and its contribution to standing results is
+retracted. Here windows are *epoch-counted*: every ingest commit is one epoch
+(ingest.py stamps them), and a :class:`WindowSpec` selects which epochs are
+live.
+
+Semantics (one rule covers both classic shapes):
+
+- the window *closes* at every epoch divisible by ``slide``; an arriving
+  epoch ``e`` first retires everything no longer reachable from the current
+  window: with ``c = ((e - 1) // slide) * slide`` the last close before
+  ``e``, all epochs ``<= c - (size - slide)`` retire.
+- ``slide=1`` (default) is a **sliding** window: the live set is always the
+  last ``size`` epochs.
+- ``slide == size`` is a **tumbling** window: the previous window's contents
+  retire in whole-window bulk as soon as the next window opens, so a
+  mid-window epoch is never evaluated against an already-reported window.
+
+Retraction strategy: delta evaluation is monotone (append-only), so expiry
+cannot be incrementalized without per-result support counting. Instead the
+window keeps the raw triples of each live epoch; on retirement the standing
+query's window store is rebuilt from the surviving epochs and the query is
+re-run from scratch over it (continuous.py `_on_epoch_windowed`). Rebuilds
+happen once per ``slide`` epochs — the amortized shape Wukong+S gets from its
+per-window sub-stores — and the diff against the previous result set yields
+the retraction deltas.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class WindowSpec:
+    """size: how many epochs stay live; slide: how often the window closes."""
+
+    size: int
+    slide: int = 1
+
+    def __post_init__(self):
+        if self.size < 1:
+            raise ValueError(f"window size must be >= 1, got {self.size}")
+        if self.slide < 1 or self.slide > self.size:
+            raise ValueError(
+                f"window slide must be in [1, size], got {self.slide}")
+
+    @classmethod
+    def tumbling(cls, size: int) -> "WindowSpec":
+        return cls(size=size, slide=size)
+
+
+@dataclass
+class EpochWindow:
+    """Live-epoch bookkeeping for one windowed standing query."""
+
+    spec: WindowSpec
+    # (epoch, triples) in epoch order — raw batches kept for rebuilds
+    live: list = field(default_factory=list)
+
+    def add(self, epoch: int, triples: np.ndarray) -> list:
+        """Admit one epoch; returns the list of (epoch, triples) entries
+        retired by this advance (non-empty only on the first epoch after a
+        close — once per ``slide``, the amortized rebuild cadence)."""
+        self.live.append((int(epoch), triples))
+        last_close = (epoch - 1) // self.spec.slide * self.spec.slide
+        cutoff = last_close - (self.spec.size - self.spec.slide)
+        retired = [ent for ent in self.live if ent[0] <= cutoff]
+        if retired:
+            self.live = [ent for ent in self.live if ent[0] > cutoff]
+        return retired
+
+    def live_epochs(self) -> list[int]:
+        return [e for e, _ in self.live]
+
+    def live_triples(self) -> np.ndarray:
+        """All live triples as one [N,3] array (rebuild input)."""
+        if not self.live:
+            return np.empty((0, 3), dtype=np.int64)
+        return np.concatenate([t for _, t in self.live])
