@@ -1100,6 +1100,27 @@ def _measure_one(qn: str, scale: int) -> dict:
             out["stream_available"] = False
             out["pallas_probe_available"] = False
             out["kernel_probe_error"] = str(e)[:200]
+    # per-step time breakdown (observability PR): ONE traced single-query
+    # execution AFTER the timed trials — the measured numbers above never
+    # see a trace (tracing default-off is the guarded hot path), and the
+    # artifact gains where the time goes (chain vs host steps, rows in/out)
+    if os.environ.get("WUKONG_BENCH_TRACE", "1") != "0":
+        try:
+            from wukong_tpu.obs import QueryTrace
+            from wukong_tpu.runtime.resilience import Deadline
+
+            qt = Parser(ss).parse(text)
+            plan(qt)
+            qt.result.blind = True
+            qt.trace = QueryTrace(kind="bench", text=qn)
+            qt.deadline = Deadline(timeout_ms=60_000)  # bounded, not open
+            eng.execute(qt)
+            out["step_breakdown"] = {
+                "status": qt.result.status_code.name,
+                "spans": qt.trace.step_summary(),
+            }
+        except Exception as e:
+            out["step_breakdown_error"] = str(e)[:200]
     _attach_roofline(out, eng, q0, bq, "const" if const_start else "rep",
                      os.environ.get("WUKONG_BENCH_BACKEND", "tpu"))
     # capacity-class behavior evidence (the at-scale de-risk artifact):

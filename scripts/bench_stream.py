@@ -13,10 +13,11 @@ Usage:
 
 import argparse
 import json
+import os
 import sys
 import time
 
-sys.path.insert(0, "/root/repo")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
@@ -67,6 +68,37 @@ def _run(base, live, ss, batch, queries):
     }
 
 
+def _traced_sample(base, live, ss, batch, queries, epochs=8):
+    """A short traced replay (tracing ON, outside the timed runs): the
+    artifact's per-phase breakdown — ingest vs eval vs per-query eval time
+    aggregated over `epochs` epoch traces from the flight recorder."""
+    from wukong_tpu.config import Global
+    from wukong_tpu.obs import get_recorder
+    from wukong_tpu.store.gstore import build_partition
+    from wukong_tpu.stream import ReplaySource, StreamContext
+
+    prev = Global.enable_tracing
+    Global.enable_tracing = True
+    rec = get_recorder()
+    rec.clear()
+    try:
+        ctx = StreamContext([build_partition(base, 0, 1)], ss)
+        for text in queries.values():
+            ctx.register(text)
+        ctx.feed_source(ReplaySource(live, batch_size=batch),
+                        max_epochs=epochs)
+    finally:
+        Global.enable_tracing = prev
+    agg = {}
+    traces = [t for t in rec.last() if t.kind == "stream"]
+    for tr in traces:
+        for name, s in tr.step_summary().items():
+            d = agg.setdefault(name, {"count": 0, "total_us": 0})
+            d["count"] += s["count"]
+            d["total_us"] += s["total_us"]
+    return {"epochs_traced": len(traces), "spans": agg}
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--scale", type=int, default=1, help="LUBM universities")
@@ -96,6 +128,10 @@ def main() -> None:
         # ingest-only ceiling first, then the standing-query runs on top
         "ingest_only": _run(base, live, ss, args.batch, {}),
         "with_standing": _run(base, live, ss, args.batch, STANDING),
+        # observability: per-phase breakdown from a short traced replay
+        # (tracing stays OFF for the timed runs above)
+        "trace_breakdown": _traced_sample(base, live, ss, args.batch,
+                                          STANDING),
     }
     with open(args.out, "w") as f:
         json.dump(out, f, indent=1, sort_keys=True)

@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""Observability sanity gate: no bare ``print(`` in wukong_tpu/ library code.
+
+Everything in the library reports through the leveled logger
+(utils/logger.py) or the metrics registry (obs/metrics.py) — stdout belongs
+to report surfaces only. Allowed:
+
+- ``runtime/console.py`` and ``runtime/monitor.py`` (the interactive
+  console and the rolling report are stdout surfaces by design)
+- calls lexically inside a function named ``main`` (CLI entry points:
+  datagen/lubm emit their JSON meta to stdout like any Unix tool)
+
+Run standalone (``python scripts/lint_obs.py``) or via the test suite
+(tests/test_obs.py::test_lint_obs_gate). Exit code 1 + one line per
+violation when the gate fails.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+ALLOWED_FILES = {
+    os.path.join("runtime", "console.py"),
+    os.path.join("runtime", "monitor.py"),
+}
+ALLOWED_FUNCS = {"main"}
+
+
+class _PrintFinder(ast.NodeVisitor):
+    def __init__(self):
+        self.func_stack: list[str] = []
+        self.hits: list[int] = []  # line numbers of disallowed prints
+
+    def visit_FunctionDef(self, node):
+        self.func_stack.append(node.name)
+        self.generic_visit(node)
+        self.func_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node):
+        if (isinstance(node.func, ast.Name) and node.func.id == "print"
+                and not (set(self.func_stack) & ALLOWED_FUNCS)):
+            self.hits.append(node.lineno)
+        self.generic_visit(node)
+
+
+def violations(pkg_root: str) -> list[str]:
+    out: list[str] = []
+    for dirpath, _dirs, files in os.walk(pkg_root):
+        for fn in sorted(files):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, pkg_root)
+            if rel in ALLOWED_FILES:
+                continue
+            with open(path) as f:
+                try:
+                    tree = ast.parse(f.read(), filename=path)
+                except SyntaxError as e:
+                    out.append(f"{rel}: syntax error: {e}")
+                    continue
+            finder = _PrintFinder()
+            finder.visit(tree)
+            out.extend(f"{rel}:{ln}: bare print() in library code "
+                       "(use utils.logger or obs.metrics)"
+                       for ln in finder.hits)
+    return out
+
+
+def main(argv=None) -> int:
+    args = argv if argv is not None else sys.argv[1:]
+    root = args[0] if args else os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "wukong_tpu")
+    bad = violations(root)
+    for line in bad:
+        print(line)
+    if bad:
+        print(f"lint_obs: {len(bad)} violation(s)")
+        return 1
+    print("lint_obs: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

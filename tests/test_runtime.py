@@ -136,7 +136,8 @@ def test_engine_pool_executes_and_steals(proxy):
 
 
 def test_step_trace():
-    from wukong_tpu.runtime.tracing import StepTrace
+    # canonical home is wukong_tpu.obs (PR 3); runtime.tracing re-exports
+    from wukong_tpu.obs import StepTrace
 
     tr = StepTrace()
     with tr.span("expand"):

@@ -616,3 +616,98 @@ def test_monitor_breaker_surface():
     assert "1 closed" in line and "1 open" in line and "last trip" in line
     clock[0] = 2.0  # past cooldown: the tripped key is probe-able
     assert mon.breaker_summary()["dist.shard"]["half_open"] == 1
+
+
+# ---------------------------------------------------------------------------
+# timestamped datagen replay (PR 3 satellite: ROADMAP PR 2 follow-up c)
+# ---------------------------------------------------------------------------
+
+def test_datagen_timestamped_filesource_epoch_assignment(tmp_path, world):
+    """datagen --timestamps emits shuffled 4-col rows; FileSource replay
+    must regroup them into per-timestamp epochs in timestamp order."""
+    from wukong_tpu.loader.datagen import convert_dir
+
+    src = tmp_path / "nt"
+    src.mkdir()
+    # TWO source files: datagen writes one id_* file per source file, each
+    # spanning the same epoch range — grouping must be global, not per file
+    with open(src / "uni0.nt", "w") as f:
+        for i in range(40):
+            f.write(f"<http://e/s{i}> <http://e/p> <http://e/o{i}> .\n")
+    with open(src / "uni1.nt", "w") as f:
+        for i in range(40, 64):
+            f.write(f"<http://e/s{i}> <http://e/p> <http://e/o{i}> .\n")
+    dst = tmp_path / "ids"
+    meta = convert_dir(str(src), str(dst), timestamps=5, ts_seed=7)
+    assert meta["timestamps"] == 5
+    raw = np.concatenate([
+        np.loadtxt(dst / "id_uni0.nt", dtype=np.int64, ndmin=2),
+        np.loadtxt(dst / "id_uni1.nt", dtype=np.int64, ndmin=2)])
+    assert raw.shape[1] == 4  # 4-column s p o ts form
+    ts = raw[:, 3]
+    assert len(np.unique(ts)) > 1  # several distinct epochs...
+    assert not np.all(ts[:-1] <= ts[1:])  # ...arriving OUT of order
+    got = list(FileSource(str(dst), batch_size=1000))
+    # epoch assignment: one batch per distinct timestamp, sorted by ts,
+    # and each batch holds exactly the rows stamped with that ts
+    assert [t for t, _ in got] == sorted(np.unique(ts).tolist())
+    for t, batch in got:
+        expect = raw[ts == int(t)][:, :3]
+        assert sorted(map(tuple, batch.tolist())) == \
+            sorted(map(tuple, expect.tolist()))
+    # and the whole replay commits cleanly as epochs
+    ctx = StreamContext([build_partition(np.empty((0, 3), np.int64), 0, 1)],
+                        None)
+    recs = ctx.feed_source(FileSource(str(dst), batch_size=1000))
+    assert [r.ts for r in recs] == [t for t, _ in got]
+    assert sum(r.n_triples for r in recs) == len(raw)
+
+
+# ---------------------------------------------------------------------------
+# push-mode sinks (PR 3 satellite: ROADMAP PR 2 follow-up d)
+# ---------------------------------------------------------------------------
+
+def test_push_callback_mirrors_poll(world):
+    triples, ss, perm = world
+    base, live = split(triples, perm, len(triples) - 400)
+    batches = [live[i:i + 128] for i in range(0, len(live), 128)]
+    got = []
+    ctx = StreamContext([build_partition(base, 0, 1)], ss)
+    qid = ctx.register(Q_ONEHOP, callback=got.append)
+    # the registration snapshot is pushed too (epoch 0 for early registrants)
+    assert [d.epoch for d in got] == [d.epoch for d in ctx.poll(qid)]
+    for b in batches:
+        ctx.feed(b)
+    pulled = ctx.poll(qid)
+    assert len(got) == len(pulled)
+    for cb, pl in zip(got, pulled):
+        assert cb.epoch == pl.epoch and cb.sign == pl.sign
+        assert np.array_equal(cb.rows, pl.rows)
+
+
+def test_push_callback_exception_contained(world):
+    from wukong_tpu.obs import get_registry
+
+    triples, ss, perm = world
+    base, live = split(triples, perm, len(triples) - 400)
+    batches = [live[i:i + 128] for i in range(0, len(live), 128)]
+
+    def bad_sink(delta):
+        raise RuntimeError("subscriber crashed")
+
+    ctx = StreamContext([build_partition(base, 0, 1)], ss)
+    qid = ctx.register(Q_ONEHOP, callback=bad_sink)
+    before = get_registry().counter(
+        "wukong_stream_callback_errors_total").value()
+    for b in batches:
+        ctx.feed(b)  # must not raise: callback errors are contained
+    sq = ctx.continuous.queries[qid]
+    assert sq.callback_errors > 0
+    assert get_registry().counter(
+        "wukong_stream_callback_errors_total").value() > before
+    # the pull surface stayed correct despite the crashing subscriber
+    merged = np.concatenate([base] + batches)
+    assert np.array_equal(ctx.result_set(qid), full_run(merged, ss, Q_ONEHOP))
+    # and a non-callable callback is a structured registration error
+    with pytest.raises(WukongError):
+        ctx.register(Q_ONEHOP, callback="not-callable")

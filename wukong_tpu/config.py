@@ -83,6 +83,23 @@ class GlobalConfig:
     breaker_threshold: int = 3
     breaker_cooldown_ms: int = 5000
 
+    # ---- observability knobs (wukong_tpu/obs/; all mutable) ----
+    # per-query tracing (trace id + span stack, proxy->engine->shard-fetch).
+    # Off by default: every hook degrades to one getattr/None check, so the
+    # bench hot path is unchanged (guarded by the PR's before/after number).
+    enable_tracing: bool = False
+    # sample 1 in N queries when tracing is enabled (1 = every query)
+    trace_sample_every: int = 1
+    # flight recorder: completed traces kept in the bounded ring
+    trace_ring: int = 64
+    # always-on slow-query log: a traced query slower than this dumps its
+    # full trace (0 disables the threshold; resilience-failure codes
+    # QUERY_TIMEOUT/BUDGET_EXCEEDED/SHARD_UNAVAILABLE always dump)
+    trace_slow_ms: int = 1000
+    # directory for JSON trace dumps ("" = in-memory only; the
+    # WUKONG_TRACE_DIR env var is the out-of-band override)
+    trace_dump_dir: str = ""
+
     # ---- TPU-engine knobs (new; no reference analogue) ----
     table_capacity_min: int = 1024  # smallest binding-table capacity class
     # largest capacity class: 32M rows x 8 cols x int32 = 1 GiB, within one

@@ -100,6 +100,14 @@ class CPUEngine:
     # top-level state machine (sparql.hpp:1564-1673)
     # ------------------------------------------------------------------
     def execute(self, q: SPARQLQuery, from_proxy: bool = True) -> SPARQLQuery:
+        from wukong_tpu.obs.trace import traced_execute
+
+        return traced_execute(
+            q, "cpu.execute", lambda: self._execute_impl(q, from_proxy),
+            lambda: {"rows": q.result.nrows,
+                     "status": q.result.status_code.name})
+
+    def _execute_impl(self, q: SPARQLQuery, from_proxy: bool) -> SPARQLQuery:
         from wukong_tpu.config import Global
 
         try:
@@ -159,9 +167,13 @@ class CPUEngine:
         from wukong_tpu.config import Global
         from wukong_tpu.runtime.resilience import charge_query, check_query
 
+        from wukong_tpu.obs.trace import traced_step
+
+        tr = getattr(q, "trace", None)
         while not q.done_patterns():
             check_query(q, f"cpu.bgp step {q.pattern_step}")
-            self._execute_one_pattern(q)
+            traced_step(tr, q, "cpu.step",
+                        lambda: self._execute_one_pattern(q))
             charge_query(q, q.result.nrows,
                          f"cpu.bgp step {q.pattern_step - 1}")
             # co-run optimization at the marked step (sparql.hpp:1130-1131)
@@ -588,6 +600,7 @@ class CPUEngine:
             child.pg_type = PGType.UNION
             child.pattern_group = sub_pg
             child.deadline = q.deadline  # children share the parent's budget
+            child.trace = getattr(q, "trace", None)  # ... and its trace
             child.result = copy.deepcopy(q.result)
             child.result.blind = False
             child.mt_factor = q.mt_factor if child.start_from_index() else 1
@@ -636,6 +649,7 @@ class CPUEngine:
         child.pqid = q.qid
         child.pg_type = PGType.OPTIONAL
         child.deadline = q.deadline  # children share the parent's budget
+        child.trace = getattr(q, "trace", None)  # ... and its trace
         child.pattern_group = copy.deepcopy(q.pattern_group.optional[q.optional_step])
         q.optional_step += 1
         self._count_optional_new_vars(child.pattern_group, q.result)

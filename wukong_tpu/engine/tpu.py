@@ -66,6 +66,7 @@ class TPUEngine:
         self.cap_max = Global.table_capacity_max
         self._est_planner = None  # lazy Planner over self.stats
         self._est_cache: dict = {}  # pattern-tuple -> {step: rows}
+        self._last_attempts = 0  # chain attempts of the last query (trace)
         from wukong_tpu.engine.tpu_merge import MergeExecutor
 
         self.merge = MergeExecutor(self)  # sort-merge batch chains (v2)
@@ -105,6 +106,15 @@ class TPUEngine:
 
     # ------------------------------------------------------------------
     def execute(self, q: SPARQLQuery, from_proxy: bool = True) -> SPARQLQuery:
+        from wukong_tpu.obs.trace import traced_execute
+
+        return traced_execute(
+            q, "tpu.execute", lambda: self._execute_impl(q, from_proxy),
+            lambda: {"rows": q.result.nrows,
+                     "status": q.result.status_code.name})
+
+    def _execute_impl(self, q: SPARQLQuery,
+                      from_proxy: bool = True) -> SPARQLQuery:
         try:
             if q.planner_empty and Global.enable_empty_shortcircuit:
                 # planner-proved empty (planner.hpp:1505-1509): no device
@@ -217,8 +227,12 @@ class TPUEngine:
             finally:
                 self.dstore.unpin(pins)
         # host fallback for any remaining steps
+        from wukong_tpu.obs.trace import traced_step
+
+        tr = getattr(q, "trace", None)
         while not q.done_patterns():
-            self.cpu._execute_one_pattern(q)
+            traced_step(tr, q, "tpu.host_step",
+                        lambda: self.cpu._execute_one_pattern(q))
 
     def _run_chain_pinned(self, q: SPARQLQuery, device_steps: int) -> None:
         # blind queries with nothing after the device chain only need the
@@ -230,12 +244,33 @@ class TPUEngine:
                     and not q.pattern_group.unions
                     and not q.pattern_group.optional
                     and not q.pattern_group.filters)
-        from wukong_tpu.runtime.resilience import charge_query, check_query
-
         cap_override: dict[int, int] = {}
         step_est = (self._chain_estimates(q.pattern_group.patterns)
                     if q.pattern_step == 0 else {})
+        # chain-level span: per-BGP-step work is fused into one compiled
+        # dispatch here, so the trace carries steps + kernel-dispatch count
+        # (attempts x steps) + rows out at chain granularity
+        tr = getattr(q, "trace", None)
+        sp = (tr.start_span("tpu.chain", steps=device_steps,
+                            rows_in=q.result.nrows)
+              if tr is not None else None)
+        try:
+            self._chain_attempts(q, device_steps, cap_override, step_est,
+                                 blind_ok)
+        finally:
+            if sp is not None:
+                tr.end_span(sp, attempts=self._last_attempts,
+                            dispatches=self._last_attempts * device_steps,
+                            rows_out=q.result.nrows)
+
+    def _chain_attempts(self, q: SPARQLQuery, device_steps: int,
+                        cap_override: dict, step_est: dict,
+                        blind_ok: bool) -> None:
+        from wukong_tpu.runtime.resilience import charge_query, check_query
+
+        self._last_attempts = 0
         for _attempt in range(8):
+            self._last_attempts = _attempt + 1
             check_query(q, f"tpu.chain attempt {_attempt}")
             state = self._dispatch_chain(q, device_steps, cap_override,
                                          step_est)

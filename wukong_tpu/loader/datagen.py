@@ -6,12 +6,19 @@ order, normal ids from 2^17 in first-seen order), detects typed-literal attribut
 triples (find_type, generate_data.cpp:53-64), honors ``@prefix`` lines
 (generate_data.cpp:144-149, 173-194), and writes ``id_<file>``/``attr_<file>`` plus
 ``str_index``, ``str_normal`` and ``str_attr_index`` tables.
+
+Streaming replay (``--timestamps N``): emit 4-column ``s p o ts`` rows with
+seeded pseudo-random timestamps drawn from N distinct epochs, deliberately
+OUT OF ORDER within the file — the shape real arrival logs have — so
+``stream.FileSource`` replay exercises its timestamp sort/group path
+instead of the synthetic in-order axis (PR 2 follow-up c).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import random
 import sys
 
 RDF_TYPE_STR = "<http://www.w3.org/1999/02/22-rdf-syntax-ns#type>"
@@ -81,10 +88,19 @@ def _expand_prefix(token: str, prefixes: dict[str, str]) -> str:
     return token
 
 
-def convert_dir(src_dir: str, dst_dir: str) -> dict:
+def convert_dir(src_dir: str, dst_dir: str, timestamps: int = 0,
+                ts_seed: int = 0) -> dict:
+    """Convert ``src_dir`` N-Triples into id-format under ``dst_dir``.
+
+    ``timestamps > 0`` switches the id_* files to the 4-column
+    ``s p o ts`` form: each row draws a seeded pseudo-random epoch in
+    [0, timestamps) — shuffled, not monotone, so replays arrive out of
+    order like real logs. 0 keeps the reference 3-column form.
+    """
     os.makedirs(dst_dir, exist_ok=True)
     ids = IdAssigner()
     nfiles = 0
+    ts_rng = random.Random(ts_seed) if timestamps > 0 else None
     for name in sorted(os.listdir(src_dir)):
         if name.startswith("."):
             continue
@@ -117,7 +133,11 @@ def convert_dir(src_dir: str, dst_dir: str) -> dict:
                 sid = ids.normal(subject)
                 pid = ids.index(predicate)
                 oid = ids.index(obj) if predicate == RDF_TYPE_STR else ids.normal(obj)
-                fout.write(f"{sid}\t{pid}\t{oid}\n")
+                if ts_rng is not None:
+                    fout.write(f"{sid}\t{pid}\t{oid}\t"
+                               f"{ts_rng.randrange(timestamps)}\n")
+                else:
+                    fout.write(f"{sid}\t{pid}\t{oid}\n")
 
     with open(os.path.join(dst_dir, "str_normal"), "w") as f:
         for s in ids.normal_str:
@@ -135,16 +155,27 @@ def convert_dir(src_dir: str, dst_dir: str) -> dict:
         "index_vertex": len(ids.index_str),
         "attr_vertex": len(ids.attr_index_str),
         "files": nfiles,
+        "timestamps": int(timestamps),
     }
     return meta
 
 
 def main(argv=None):
-    args = argv if argv is not None else sys.argv[1:]
-    if len(args) != 2:
-        print("usage: python -m wukong_tpu.loader.datagen <src_dir> <dst_dir>")
-        return 1
-    meta = convert_dir(args[0], args[1])
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m wukong_tpu.loader.datagen",
+        description="NT -> ID-Triples converter")
+    ap.add_argument("src_dir")
+    ap.add_argument("dst_dir")
+    ap.add_argument("--timestamps", type=int, default=0, metavar="N",
+                    help="emit 4-column s p o ts rows with shuffled "
+                         "timestamps over N epochs (streaming replay)")
+    ap.add_argument("--ts-seed", type=int, default=0,
+                    help="seed for the timestamp shuffle")
+    ns = ap.parse_args(argv if argv is not None else sys.argv[1:])
+    meta = convert_dir(ns.src_dir, ns.dst_dir, timestamps=ns.timestamps,
+                       ts_seed=ns.ts_seed)
     print(json.dumps(meta))
     return 0
 

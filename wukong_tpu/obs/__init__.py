@@ -1,0 +1,44 @@
+"""Observability subsystem: tracing, metrics, flight recorder, exporters.
+
+What the reference never had (SURVEY §5: "no pervasive tracing framework")
+and every perf PR after this one stands on:
+
+- trace.py    — per-query :class:`QueryTrace` (trace id + span stack),
+  thread-ambient activation for deep layers, sampling knobs, StepTrace
+- metrics.py  — process-wide :class:`MetricsRegistry` (labeled counters /
+  gauges / histograms; Prometheus-text + JSON snapshot exporters)
+- recorder.py — :class:`FlightRecorder` ring of recent traces with
+  auto-dump on resilience failures and slow queries
+- export.py   — Chrome trace-event JSON (Perfetto) + JAX device profiler
+
+Config knobs (all runtime-mutable, config.py): ``enable_tracing`` (default
+off — the hot path pays one getattr), ``trace_sample_every``,
+``trace_ring``, ``trace_slow_ms``, ``trace_dump_dir``.
+"""
+
+from __future__ import annotations
+
+from wukong_tpu.obs.export import (
+    chrome_trace_events,
+    device_trace,
+    maybe_device_trace,
+    write_chrome_trace,
+)
+from wukong_tpu.obs.metrics import MetricsRegistry, get_registry
+from wukong_tpu.obs.recorder import DUMP_CODES, FlightRecorder, get_recorder
+from wukong_tpu.obs.trace import (
+    QueryTrace,
+    Span,
+    StepTrace,
+    activate,
+    current,
+    maybe_start_trace,
+    trace_event,
+)
+
+__all__ = [
+    "DUMP_CODES", "FlightRecorder", "MetricsRegistry", "QueryTrace", "Span",
+    "StepTrace", "activate", "chrome_trace_events", "current", "device_trace",
+    "get_recorder", "get_registry", "maybe_device_trace", "maybe_start_trace",
+    "trace_event", "write_chrome_trace",
+]

@@ -1,0 +1,121 @@
+"""Flight recorder: bounded ring of completed traces + slow/failed dumps.
+
+A production incident rarely leaves the query that caused it re-runnable —
+the flight recorder keeps the last N completed :class:`QueryTrace`s in a
+ring so "what just happened" is answerable after the fact (console verb
+``trace``), and auto-dumps the full trace when a query ends in one of the
+resilience failure codes (QUERY_TIMEOUT / BUDGET_EXCEEDED /
+SHARD_UNAVAILABLE — chaos-suite failures come with their trace attached)
+or exceeds the always-on slow-query threshold (``trace_slow_ms``).
+
+Dumps land in memory (``dumps`` ring, console-inspectable) and — when
+``trace_dump_dir`` (or ``WUKONG_TRACE_DIR``) names a directory — as one
+JSON file per trace, Chrome-trace-viewable via obs/export.py.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from collections import deque
+
+from wukong_tpu.config import Global
+from wukong_tpu.obs.metrics import get_registry
+from wukong_tpu.obs.trace import QueryTrace
+from wukong_tpu.utils.errors import ErrorCode
+from wukong_tpu.utils.logger import log_warn
+
+#: reply codes that auto-dump their trace (the resilience failure taxonomy)
+DUMP_CODES = frozenset({ErrorCode.QUERY_TIMEOUT, ErrorCode.BUDGET_EXCEEDED,
+                        ErrorCode.SHARD_UNAVAILABLE})
+
+
+class FlightRecorder:
+    def __init__(self, capacity: int | None = None):
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._ring: deque[QueryTrace] = deque(
+            maxlen=capacity or max(int(Global.trace_ring), 1))
+        self.dumps: deque[tuple[str, QueryTrace]] = deque(maxlen=64)
+        reg = get_registry()
+        self._m_recorded = reg.counter(
+            "wukong_traces_recorded_total", "Completed query traces kept")
+        self._m_dumped = reg.counter(
+            "wukong_trace_dumps_total", "Auto-dumped traces", labels=("reason",))
+
+    # ------------------------------------------------------------------
+    def on_complete(self, trace: QueryTrace | None,
+                    status: ErrorCode | int | str = ErrorCode.SUCCESS) -> None:
+        """Record one finished trace; dump it when the status or duration
+        says so. Accepts None so callers can pass ``q.trace`` unchecked."""
+        if trace is None:
+            return
+        code: ErrorCode | None
+        try:
+            code = ErrorCode(status) if not isinstance(status, str) else None
+        except ValueError:
+            code = None
+        trace.finish(code.name if code is not None else str(status))
+        want = self.capacity or max(int(Global.trace_ring), 1)
+        with self._lock:
+            if self._ring.maxlen != want:
+                # trace_ring is runtime-mutable; re-size lazily, keeping
+                # the tail (check+swap+append in ONE critical section — a
+                # concurrent completion must never land in the old deque)
+                self._ring = deque(self._ring, maxlen=want)
+            self._ring.append(trace)
+        self._m_recorded.inc()
+        reason = None
+        if code is not None and code in DUMP_CODES:
+            reason = code.name
+        elif (Global.trace_slow_ms > 0
+              and trace.dur_us >= Global.trace_slow_ms * 1000):
+            reason = "SLOW_QUERY"
+        if reason is not None:
+            self._dump(trace, reason)
+
+    def _dump(self, trace: QueryTrace, reason: str) -> None:
+        with self._lock:
+            self.dumps.append((reason, trace))
+        self._m_dumped.labels(reason=reason).inc()
+        log_warn(f"flight recorder: trace {trace.trace_id} dumped "
+                 f"({reason}, {trace.dur_us:,}us, {len(trace.spans)} spans)")
+        dump_dir = Global.trace_dump_dir or os.environ.get("WUKONG_TRACE_DIR")
+        if dump_dir:
+            try:
+                os.makedirs(dump_dir, exist_ok=True)
+                path = os.path.join(dump_dir,
+                                    f"trace_{trace.trace_id}.json")
+                with open(path, "w") as f:
+                    json.dump({"reason": reason, **trace.to_dict()}, f,
+                              indent=1, sort_keys=True)
+            except OSError as e:  # a full disk must not fail the query path
+                log_warn(f"flight recorder: dump write failed: {e}")
+
+    # ------------------------------------------------------------------
+    def last(self, n: int | None = None) -> list[QueryTrace]:
+        with self._lock:
+            traces = list(self._ring)
+        return traces if n is None else traces[-n:]
+
+    def find(self, key) -> QueryTrace | None:
+        """Look up a ring entry by qid (int) or trace id (str)."""
+        with self._lock:
+            traces = list(self._ring)
+        for tr in reversed(traces):
+            if tr.trace_id == key or str(tr.qid) == str(key):
+                return tr
+        return None
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self.dumps.clear()
+
+
+_recorder = FlightRecorder()
+
+
+def get_recorder() -> FlightRecorder:
+    return _recorder

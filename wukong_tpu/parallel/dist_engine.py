@@ -102,6 +102,18 @@ class DistEngine:
 
     # ------------------------------------------------------------------
     def execute(self, q: SPARQLQuery, from_proxy: bool = True) -> SPARQLQuery:
+        from wukong_tpu.obs.trace import traced_execute
+
+        # the ambient activation makes shard fetches / retries / breaker
+        # trips land on this query's trace (see traced_execute)
+        return traced_execute(
+            q, "dist.execute", lambda: self._execute_impl(q, from_proxy),
+            lambda: {"rows": q.result.nrows,
+                     "status": q.result.status_code.name,
+                     "complete": q.result.complete})
+
+    def _execute_impl(self, q: SPARQLQuery,
+                      from_proxy: bool = True) -> SPARQLQuery:
         if self.sstore.check_version():
             # compiled chains bake per-segment max_probe/depth — stale after
             # dynamic inserts (dynamic_gstore.hpp lease invalidation analogue);
@@ -226,7 +238,23 @@ class DistEngine:
             seed = None
             if q.result.col_num > 0:  # seeded child (UNION branch on a table)
                 seed = (q.result.table, dict(q.result.v2c_map))
-            self._run_device_bgp(q, n_steps=split - q.pattern_step, seed=seed)
+            tr = getattr(q, "trace", None)
+            if tr is None:
+                self._run_device_bgp(q, n_steps=split - q.pattern_step,
+                                     seed=seed)
+            else:
+                sp = tr.start_span("dist.chain",
+                                   steps=split - q.pattern_step,
+                                   rows_in=q.result.nrows)
+                try:
+                    self._run_device_bgp(q, n_steps=split - q.pattern_step,
+                                         seed=seed)
+                finally:
+                    st = getattr(self, "last_chain_stats", None) or {}
+                    tr.end_span(sp, rows_out=q.result.nrows,
+                                **{k: st[k] for k in
+                                   ("mode", "retries", "exchanges")
+                                   if k in st})
         while not q.done_patterns():  # attr tail (or attr-only query)
             self._attr_host()._execute_one_pattern(q)
 
@@ -271,6 +299,10 @@ class DistEngine:
         target = q.pattern_step + n_steps
         from wukong_tpu.runtime.resilience import charge_query, check_query
 
+        tr = getattr(q, "trace", None)
+        sp = (tr.start_span("dist.inplace", steps=n_steps,
+                            rows_in=q.result.nrows)
+              if tr is not None else None)
         try:
             while q.pattern_step < target:
                 check_query(q, f"dist.inplace step {q.pattern_step}")
@@ -282,7 +314,15 @@ class DistEngine:
         except InplaceOverflow:
             q.pattern_step = snap_step
             q.result = snap_res
+            if sp is not None:  # aborted to the collective chain
+                tr.end_span(sp, ok=False, overflow=True)
             return False
+        except BaseException:
+            if sp is not None:
+                tr.end_span(sp, ok=False, raised=True)
+            raise
+        if sp is not None:
+            tr.end_span(sp, ok=True, rows_out=int(q.result.nrows))
         if q.result.blind and q.done_patterns():
             # blind parity with the collective chain (which never gathers
             # the table): count survives, rows are dropped. A pending attr

@@ -95,7 +95,27 @@ class ShardedDeviceStore:
         and the per-shard circuit breaker. Returns (value, ok); ok=False
         marks the shard degraded — the caller substitutes empty shard data
         so the compiled chain routes around the shard instead of crashing.
-        A later successful fetch clears the degraded flag (recovery)."""
+        A later successful fetch clears the degraded flag (recovery).
+
+        Observability: when the executing query is traced, each fetch is a
+        ``shard.fetch`` span on the ambient trace — retry attempts, breaker
+        trips, and injected fault sites land on it as span events (the
+        retry/breaker/fault hooks use the same ambient trace)."""
+        from wukong_tpu.obs import trace as obs_trace
+
+        tr = obs_trace.current()
+        if tr is None:
+            return self._fetch_shard_impl(i, fn, what)
+        sp = tr.start_span("shard.fetch", shard=i, what=what)
+        try:
+            out, ok = self._fetch_shard_impl(i, fn, what)
+        except BaseException:
+            tr.end_span(sp, ok=False, raised=True)
+            raise
+        tr.end_span(sp, ok=ok)
+        return out, ok
+
+    def _fetch_shard_impl(self, i: int, fn, what: str):
         from wukong_tpu.runtime import faults
         from wukong_tpu.runtime.resilience import retry_call
         from wukong_tpu.utils.errors import RetryExhausted, ShardUnavailable
@@ -115,16 +135,25 @@ class ShardedDeviceStore:
             # touching the shard
             log_warn(f"shard {i} down during {what} ({e}); substituting an "
                      "empty shard — results will be flagged incomplete")
-            self.degraded_shards.add(i)
+            self._mark_degraded(i)
             return None, False
         except (ShardUnavailable, RetryExhausted) as e:
             log_warn(f"shard {i} unavailable during {what} "
                      f"({e.code.name}); substituting an empty shard — "
                      "results will be flagged incomplete")
-            self.degraded_shards.add(i)
+            self._mark_degraded(i)
             return None, False
         self.degraded_shards.discard(i)
         return out, True
+
+    def _mark_degraded(self, i: int) -> None:
+        from wukong_tpu.obs.metrics import get_registry
+
+        self.degraded_shards.add(i)
+        get_registry().counter(
+            "wukong_shard_fetch_degraded_total",
+            "Shard fetches that substituted empty data",
+            labels=("shard",)).labels(shard=i).inc()
 
     def _put(self, arr: np.ndarray):
         import jax

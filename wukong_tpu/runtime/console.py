@@ -30,6 +30,11 @@ load -d <dir>                dynamic (incremental) load
 gsck [-i] [-n]               check store integrity
 load-stat [-f <file>]        load optimizer statistics
 store-stat [-f <file>]       store optimizer statistics
+trace [-q <qid|id>] [-n <k>] [-o <file>]
+                             flight recorder: list recent traces, print one
+                             query's span tree by qid/trace id, or export
+                             Chrome trace JSON (open in ui.perfetto.dev)
+metrics [-j]                 dump the metrics registry (Prometheus text, -j JSON)
 """
 
 
@@ -75,6 +80,10 @@ class Console:
                 self._stat(rest, load=True)
             elif cmd == "store-stat":
                 self._stat(rest, load=False)
+            elif cmd == "trace":
+                self._trace(rest)
+            elif cmd == "metrics":
+                self._metrics(rest)
             else:
                 log_error(f"unknown command: {cmd} (try 'help')")
         except WukongError as e:
@@ -140,6 +149,7 @@ class Console:
                                     print_results=ns.v)
 
     def _emu(self, rest) -> None:
+        from wukong_tpu.obs import maybe_device_trace
         from wukong_tpu.runtime.emulator import Emulator, load_mix_config
 
         ap = argparse.ArgumentParser(prog="sparql-emu")
@@ -151,8 +161,77 @@ class Console:
                         help="in-flight cap across the engine pool")
         ns = ap.parse_args(rest)
         mix = load_mix_config(ns.f, self.proxy.str_server)
-        Emulator(self.proxy).run(mix, duration_s=ns.d, warmup_s=ns.w,
-                                 batch=ns.b, parallel=ns.p)
+        # WUKONG_XPROF_DIR scopes the JAX profiler around the whole run
+        # (XProf/TensorBoard view of the device side); off by default
+        with maybe_device_trace():
+            Emulator(self.proxy).run(mix, duration_s=ns.d, warmup_s=ns.w,
+                                     batch=ns.b, parallel=ns.p)
+
+    # ------------------------------------------------------------------
+    def _trace(self, rest) -> None:
+        """Flight-recorder verbs (report path: console prints directly)."""
+        from wukong_tpu.obs import get_recorder, write_chrome_trace
+
+        ap = argparse.ArgumentParser(prog="trace")
+        ap.add_argument("-q", default=None,
+                        help="fetch one trace by qid or trace id")
+        ap.add_argument("-n", type=int, default=16,
+                        help="how many recent traces to list/export")
+        ap.add_argument("-o", default=None,
+                        help="export Chrome trace JSON to this path")
+        ns = ap.parse_args(rest)
+        rec = get_recorder()
+        if ns.o is not None:
+            traces = ([rec.find(ns.q)] if ns.q is not None
+                      else rec.last(ns.n))
+            traces = [t for t in traces if t is not None]
+            if not traces:
+                log_error("no traces recorded (enable_tracing on?)")
+                return
+            print(f"wrote {len(traces)} trace(s) to "
+                  f"{write_chrome_trace(ns.o, traces)}")
+            return
+        if ns.q is not None:
+            tr = rec.find(ns.q)
+            if tr is None:
+                log_error(f"no trace for {ns.q!r} in the flight recorder")
+                return
+            print(f"trace {tr.trace_id} qid={tr.qid} kind={tr.kind} "
+                  f"status={tr.status} dur={tr.dur_us:,}us")
+            if tr.text:
+                print(f"  query: {' '.join(tr.text.split())[:120]}")
+            for sp in tr.spans:
+                pad = "  " * (sp.depth + 1)
+                attrs = " ".join(f"{k}={v}" for k, v in sp.attrs.items())
+                print(f"{pad}{sp.name} {sp.dur_us:,}us"
+                      + (f" [{attrs}]" if attrs else ""))
+                for (_t, name, a) in sp.events:
+                    ev = " ".join(f"{k}={v}" for k, v in a.items())
+                    print(f"{pad}  ! {name}" + (f" [{ev}]" if ev else ""))
+            return
+        traces = rec.last(ns.n)
+        if not traces:
+            log_error("flight recorder is empty (enable_tracing on?)")
+            return
+        for tr in traces:
+            print(f"{tr.trace_id}  qid={tr.qid:<6} {tr.kind:<7} "
+                  f"{tr.status:<16} {tr.dur_us:>10,}us "
+                  f"{len(tr.spans):>3} spans")
+        if rec.dumps:
+            print(f"({len(rec.dumps)} auto-dumped: "
+                  + ", ".join(f"{r}:{t.trace_id}"
+                              for r, t in list(rec.dumps)[-8:]) + ")")
+
+    def _metrics(self, rest) -> None:
+        from wukong_tpu.obs import get_registry
+
+        if "-j" in rest:
+            import json
+
+            print(json.dumps(get_registry().snapshot(), indent=1,
+                             sort_keys=True))
+        else:
+            print(get_registry().render_prometheus(), end="")
 
     def _stat(self, rest, load: bool) -> None:
         """load-stat / store-stat: persist optimizer statistics

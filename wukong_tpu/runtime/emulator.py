@@ -25,6 +25,7 @@ import time
 import numpy as np
 
 from wukong_tpu.config import Global
+from wukong_tpu.obs import get_recorder, maybe_start_trace, write_chrome_trace
 from wukong_tpu.planner.heuristic import heuristic_plan
 from wukong_tpu.runtime.monitor import Monitor
 from wukong_tpu.runtime.resilience import Deadline
@@ -192,19 +193,27 @@ class Emulator:
                 # Attached per INSTANCE, never on the cached q0 — a deadline
                 # is wall-clock state that must start at submit time.
                 q.deadline = Deadline.from_config()
+                # sampled per-instance trace (queue + engine spans) when
+                # tracing is enabled; completions feed the flight recorder
+                q.trace = maybe_start_trace(kind="emu")
                 prev = self.class_mode.get(cls)
                 # a class that device-batched earlier and now rides the pool
                 # has MIXED samples — the label must say so, not claim either
                 self.class_mode[cls] = ("pool" if prev in (None, "pool")
                                         else "mixed")
-                inflight[pool.submit(q)] = (cls, get_usec())
+                inflight[pool.submit(q)] = (cls, get_usec(), q.trace)
                 submitted = True
             done = pool.poll()
             for qid, out in done:
                 info = inflight.pop(qid, None)
                 if info is None:  # stale completion from an aborted prior run
                     continue
-                cls, t0 = info
+                cls, t0, qtrace = info
+                if qtrace is not None:
+                    status = (getattr(out, "code", "ERROR")
+                              if isinstance(out, Exception)
+                              else out.result.status_code)
+                    get_recorder().on_complete(qtrace, status)
                 if isinstance(out, Exception):
                     if isinstance(out, (QueryTimeout, BudgetExceeded)):
                         # deadline/budget load shedding is the resilience
@@ -246,6 +255,13 @@ class Emulator:
                  f"{precompiled}-class precompile; "
                  f"{'TPU batch + ' if use_tpu else ''}pool p={p_cap})")
         self.monitor.print_cdf(labels=self.class_mode)
+        chrome = os.environ.get("WUKONG_TRACE_CHROME")
+        if chrome:
+            # per-emulator-run Chrome trace-event export: every trace the
+            # flight recorder holds (this run's sampled queries + stream
+            # epochs), Perfetto-loadable
+            log_info("sparql-emu: Chrome trace written to "
+                     f"{write_chrome_trace(chrome, get_recorder().last())}")
         return {"thpt_qps": thpt, "warm_qps": thpt,
                 "wall_qps": round(wall_qps, 1),
                 "precompiled_classes": precompiled, "errors": errors,

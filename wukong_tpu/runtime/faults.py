@@ -106,6 +106,17 @@ class FaultPlan:
                 continue
             sp.fired += 1
             self.history.append((site, shard, sp.kind))
+            # observability: injected faults land on the ambient trace and
+            # the metrics registry, so a chaos run's trace explains itself
+            from wukong_tpu.obs.metrics import get_registry
+            from wukong_tpu.obs.trace import trace_event
+
+            trace_event("fault.injected", site=site, kind=sp.kind,
+                        shard=shard)
+            get_registry().counter(
+                "wukong_faults_injected_total", "Injected fault firings",
+                labels=("site", "kind")).labels(site=site,
+                                                kind=sp.kind).inc()
             if sp.kind == "delay":
                 self.sleep(sp.delay_s)
             elif sp.kind == "transient":

@@ -8,6 +8,12 @@ Beyond the reference: streaming metrics (per-epoch ingest/eval latency and
 commit-to-results lag, fed by stream/ingest.py) and per-shard circuit-breaker
 state (attached CircuitBreakers from the resilience layer), both folded into
 the rolling throughput report.
+
+Observability (PR 3): latencies ALSO publish into the process-wide
+MetricsRegistry (``wukong_query_latency_us`` histogram) and attached
+breakers export a pull gauge (``wukong_breaker_open``) — the Monitor's
+private vectors keep feeding the CDF prints, the registry feeds the
+Prometheus/JSON exporters.
 """
 
 from __future__ import annotations
@@ -16,8 +22,36 @@ from collections import defaultdict, deque
 
 import numpy as np
 
+from wukong_tpu.obs.metrics import get_registry
 from wukong_tpu.utils.logger import log_info
 from wukong_tpu.utils.timer import get_usec
+
+_M_LATENCY = get_registry().histogram(
+    "wukong_query_latency_us", "Per-query latency by class (usec)",
+    labels=("qtype",))
+
+# every live Monitor with attached breakers feeds ONE registry-level pull
+# gauge (weakly referenced: dropped monitors vanish from the export instead
+# of lingering as stale series or being pinned in memory). With several
+# monitors exporting the same breaker name, the last-iterated value wins —
+# they share the breaker object via share_observability, so values agree.
+import weakref  # noqa: E402
+
+_BREAKER_MONITORS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def _breaker_open_series() -> dict:
+    out: dict = {}
+    for m in list(_BREAKER_MONITORS):
+        for nm, br in m._breakers.items():
+            out[(nm,)] = sum(1 for st in br.snapshot().values()
+                             if st["state"] != "closed")
+    return out
+
+
+get_registry().gauge(
+    "wukong_breaker_open", "Breaker keys not in the closed state",
+    labels=("name",)).set_function(_breaker_open_series)
 
 # per-epoch latency samples kept for the stream CDF (bounds memory on
 # long-running ingest loops; the totals keep counting past it)
@@ -84,13 +118,16 @@ class Monitor:
     def end_record(self, qid: int, qtype: int = 0) -> None:
         t = get_usec()
         if qid in self._start:
-            self.latencies[qtype].append(t - self._start.pop(qid))
+            dt = t - self._start.pop(qid)
+            self.latencies[qtype].append(dt)
             self.cnt += 1
+            _M_LATENCY.labels(qtype=qtype).observe(dt)
 
     def add_latency(self, usec: float, qtype: int = 0, count: int = 1) -> None:
         """Record an aggregate measurement (batched execution)."""
         self.latencies[qtype].extend([usec] * count)
         self.cnt += count
+        _M_LATENCY.labels(qtype=qtype).observe(usec, count=count)
 
     # -- open-loop throughput (monitor.hpp timely print) -------------------
     def start_thpt(self) -> None:
@@ -151,8 +188,11 @@ class Monitor:
     # -- circuit breakers (resilience satellite: PR 1 follow-up) -----------
     def attach_breaker(self, name: str, breaker) -> None:
         """Register a CircuitBreaker for state surfacing (e.g. the sharded
-        store's per-shard breaker). Idempotent by name."""
+        store's per-shard breaker). Idempotent by name. Also exports a
+        pull gauge into the metrics registry: keys not in the closed state,
+        read from the breaker snapshot at export time."""
         self._breakers[name] = breaker
+        _BREAKER_MONITORS.add(self)  # feeds the wukong_breaker_open gauge
 
     def breaker_summary(self) -> dict[str, dict]:
         """name -> {counts by state, last_trip_age_s (most recent across

@@ -16,6 +16,13 @@ from __future__ import annotations
 import numpy as np
 
 from wukong_tpu.config import Global
+from wukong_tpu.obs import (
+    activate,
+    get_recorder,
+    get_registry,
+    maybe_device_trace,
+    maybe_start_trace,
+)
 from wukong_tpu.planner.heuristic import heuristic_plan
 from wukong_tpu.planner.plan_file import set_plan
 from wukong_tpu.runtime.monitor import Monitor
@@ -38,6 +45,13 @@ class Proxy:
         self.dist = dist_engine
         self.planner = planner  # cost-based optimizer (optional)
         self.monitor = Monitor()
+        # observability: flight recorder ring + process metrics registry
+        # (console verbs `trace` / `metrics` read these back)
+        self.recorder = get_recorder()
+        self.metrics = get_registry()
+        self._m_queries = self.metrics.counter(
+            "wukong_queries_total", "Proxy queries by reply status",
+            labels=("status",))
         self._pool = None
         self._stream = None
         # surface the sharded store's per-shard breaker in the rolling
@@ -95,57 +109,54 @@ class Proxy:
             log_info("-m (mt_factor) is vectorized away on this engine; "
                      "running the full index scan")
 
+        # per-query trace context, created at receipt (sampled; None when
+        # tracing is off — every downstream hook then degrades to a getattr)
+        trace = maybe_start_trace(kind="query", text=text)
+
         def prepare():
-            qq = Parser(self.str_server).parse(text)
-            qq.mt_factor = 1
-            qq.result.blind = Global.silent if blind is None else blind
-            # per-query deadline + work budget from the resilience knobs
-            # (query_deadline_ms / query_budget_rows; None when both off)
-            qq.deadline = Deadline.from_config()
-            self._plan(qq, plan_text)
+            if trace is None:
+                qq = Parser(self.str_server).parse(text)
+                self._plan_prepared(qq, blind, plan_text)
+                return qq
+            with trace.span("proxy.parse"):
+                qq = Parser(self.str_server).parse(text)
+            qq.trace = trace
+            qq.qid = trace.qid
+            with trace.span("proxy.plan"):
+                self._plan_prepared(qq, blind, plan_text)
             return qq
 
         if repeats < 1:
             raise WukongError(ErrorCode.SYNTAX_ERROR, "repeats must be >= 1")
         q = None
         total_us = 0
-        for i in range(repeats):
-            q = prepare()
-            eng = self._engine_for(q, device)
-            t0 = get_usec()
-            eng.execute(q)
-            total_us += get_usec() - t0
-            if (q.result.status_code == ErrorCode.UNSUPPORTED_SHAPE
-                    and eng is self.dist):
-                # the distributed engine rejects some shapes up front
-                # (UNION/OPTIONAL/versatile) — fall back to the configured
-                # host engine. Capacity-exhaustion failures keep their error
-                # status (falling back would materialize the oversized table
-                # on one host).
-                log_info("distributed engine rejected the plan shape; "
-                         "falling back to the host engine")
-                host = self._engine_for(q, None) or self.cpu
-                if host is None or host is self.dist:
-                    break  # no host engine available: keep the error status
-                q = prepare()
-                t0 = get_usec()
-                host.execute(q)
-                total_us += get_usec() - t0
-            elif (q.result.status_code == ErrorCode.CAPACITY_EXCEEDED
-                  and eng is self.tpu and self.cpu is not None):
-                # graceful degradation: the device capacity ceiling is a
-                # TPU constraint, not a query property — the CPU engine has
-                # no capacity classes, so re-run host-side (the resilience
-                # analogue of the GPU->CPU spill in WCOJ-on-GPU engines)
-                log_info("device capacity exceeded; degrading to the CPU "
-                         "engine")
-                q = prepare()
-                t0 = get_usec()
-                self.cpu.execute(q)
-                total_us += get_usec() - t0
-            if q.result.status_code in (ErrorCode.QUERY_TIMEOUT,
-                                        ErrorCode.BUDGET_EXCEEDED):
-                break  # deadline/budget spent: further repeats are pointless
+        # activate the trace on the proxy thread too (parse/plan/fallback
+        # decisions), and scope the JAX device profiler around the traced
+        # execution when WUKONG_XPROF_DIR asks for an XProf capture
+        try:
+            with activate(trace), maybe_device_trace():
+                q, total_us = self._run_repeats(prepare, repeats, device,
+                                                trace)
+        except Exception as e:
+            # a parse/plan failure raises before any reply exists — it must
+            # still reach the reply-side observability (a syntax-error storm
+            # is an operational signal, not a silent gap)
+            code = e.code if isinstance(e, WukongError) else "ERROR"
+            self._m_queries.labels(
+                status=code.name if isinstance(code, ErrorCode)
+                else str(code)).inc()
+            if trace is not None:
+                self.recorder.on_complete(trace, code)
+            raise
+        # reply-side observability: the finished trace enters the flight
+        # recorder (auto-dumping on timeout/budget/shard failures), and the
+        # reply status lands on the metrics registry
+        status = q.result.status_code
+        self._m_queries.labels(status=status.name).inc()
+        if trace is not None:
+            self.recorder.on_complete(trace, status)
+            log_info(f"trace {trace.trace_id} (qid {trace.qid}) recorded: "
+                     f"{len(trace.spans)} spans, {trace.dur_us:,}us")
         if q.result.status_code != ErrorCode.SUCCESS:
             if not q.result.complete:
                 # structured partial reply, not a crash: the rows produced
@@ -162,6 +173,66 @@ class Proxy:
         if print_results and not q.result.blind:
             self.print_result(q, min(print_results, q.result.nrows))
         return q
+
+    def _run_repeats(self, prepare, repeats: int, device, trace):
+        """The repeat/fallback execution loop (shape + capacity
+        degradation); returns (last query, total execution usec)."""
+        q = None
+        total_us = 0
+        for i in range(repeats):
+            q = prepare()
+            eng = self._engine_for(q, device)
+            t0 = get_usec()
+            eng.execute(q)
+            total_us += get_usec() - t0
+            if (q.result.status_code == ErrorCode.UNSUPPORTED_SHAPE
+                    and eng is self.dist):
+                # the distributed engine rejects some shapes up front
+                # (UNION/OPTIONAL/versatile) — fall back to the
+                # configured host engine. Capacity-exhaustion failures
+                # keep their error status (falling back would
+                # materialize the oversized table on one host).
+                log_info("distributed engine rejected the plan shape; "
+                         "falling back to the host engine")
+                host = self._engine_for(q, None) or self.cpu
+                if host is None or host is self.dist:
+                    break  # no host engine: keep the error status
+                if trace is not None:
+                    trace.event("proxy.fallback", reason="shape",
+                                to="host")
+                q = prepare()
+                t0 = get_usec()
+                host.execute(q)
+                total_us += get_usec() - t0
+            elif (q.result.status_code == ErrorCode.CAPACITY_EXCEEDED
+                  and eng is self.tpu and self.cpu is not None):
+                # graceful degradation: the device capacity ceiling is a
+                # TPU constraint, not a query property — the CPU engine
+                # has no capacity classes, so re-run host-side (the
+                # resilience analogue of the GPU->CPU spill in
+                # WCOJ-on-GPU engines)
+                log_info("device capacity exceeded; degrading to the "
+                         "CPU engine")
+                if trace is not None:
+                    trace.event("proxy.fallback", reason="capacity",
+                                to="cpu")
+                q = prepare()
+                t0 = get_usec()
+                self.cpu.execute(q)
+                total_us += get_usec() - t0
+            if q.result.status_code in (ErrorCode.QUERY_TIMEOUT,
+                                        ErrorCode.BUDGET_EXCEEDED):
+                break  # deadline/budget spent: repeats are pointless
+        return q, total_us
+
+    def _plan_prepared(self, qq: SPARQLQuery, blind, plan_text) -> None:
+        """Shared prepare tail: blind mode, resilience knobs, planning."""
+        qq.mt_factor = 1
+        qq.result.blind = Global.silent if blind is None else blind
+        # per-query deadline + work budget from the resilience knobs
+        # (query_deadline_ms / query_budget_rows; None when both off)
+        qq.deadline = Deadline.from_config()
+        self._plan(qq, plan_text)
 
     def print_result(self, q: SPARQLQuery, rows: int) -> None:
         """Render rows through the string server (proxy.hpp:247-294)."""
@@ -252,10 +323,15 @@ class Proxy:
             targets += [g for g in self.dist.sstore.stores if g is not self.g]
         return targets
 
-    def stream_register(self, text: str, window=None, base_triples=None) -> int:
-        """Register a standing SPARQL query; returns its stream qid."""
+    def stream_register(self, text: str, window=None, base_triples=None,
+                        callback=None) -> int:
+        """Register a standing SPARQL query; returns its stream qid.
+        ``callback`` is the push-mode sink: invoked per committed
+        ResultDelta next to the pull poll() surface (exceptions contained
+        and surfaced as the stream-callback-error metric)."""
         return self.stream_context().register(text, window=window,
-                                              base_triples=base_triples)
+                                              base_triples=base_triples,
+                                              callback=callback)
 
     def stream_unregister(self, qid: int) -> None:
         self.stream_context().unregister(qid)

@@ -29,12 +29,24 @@ import threading
 import time
 
 from wukong_tpu.config import Global
+from wukong_tpu.obs.metrics import get_registry
+from wukong_tpu.obs.trace import trace_event
 from wukong_tpu.utils.errors import (
     BudgetExceeded,
     QueryTimeout,
     RetryExhausted,
     ShardUnavailable,
 )
+
+# observability: retry attempts and breaker trips publish into the shared
+# registry and, when a trace is ambient, appear as span events — the chaos
+# suite asserts a faulted query's trace carries them (tests/test_obs.py)
+_M_RETRIES = get_registry().counter(
+    "wukong_retry_attempts_total",
+    "Failed attempts that entered retry backoff", labels=("site",))
+_M_BREAKER_TRIPS = get_registry().counter(
+    "wukong_breaker_trips_total",
+    "Circuit breaker open/reopen transitions", labels=("key",))
 
 
 class Deadline:
@@ -142,6 +154,7 @@ def retry_call(fn, *, site: str = "", attempts: int | None = None,
     last: BaseException | None = None
     for i in range(attempts):
         if breaker is not None and not breaker.allow(key):
+            trace_event("breaker.open", site=site, key=str(key))
             raise ShardUnavailable(
                 f"circuit open for {key!r} at {site}", shard=key
                 if isinstance(key, int) else None)
@@ -161,6 +174,8 @@ def retry_call(fn, *, site: str = "", attempts: int | None = None,
             out = fn()
         except retry_on as e:
             last = e
+            trace_event("retry", site=site, attempt=i, error=repr(e))
+            _M_RETRIES.labels(site=site or "?").inc()
             if breaker is not None:
                 breaker.record_failure(key)
             if i == attempts - 1:
@@ -250,7 +265,10 @@ class CircuitBreaker:
 
     def record_success(self, key) -> None:
         with self._lock:
+            was_open = self._st.get(key, [0, None, False])[1] is not None
             self._st[key] = [0, None, False]
+        if was_open:  # a half-open trial just recovered the key
+            trace_event("breaker.close", key=str(key))
 
     def record_abort(self, key) -> None:
         """The admitted call never dispatched (e.g. deadline expiry between
@@ -260,6 +278,7 @@ class CircuitBreaker:
             self._slot(key)[2] = False
 
     def record_failure(self, key) -> None:
+        tripped = False
         with self._lock:
             slot = self._slot(key)
             slot[0] += 1
@@ -268,10 +287,15 @@ class CircuitBreaker:
                 slot[1] = self._clock()
                 slot[2] = False
                 self._last_trip[key] = slot[1]
+                tripped = True
             elif slot[0] >= self.threshold:
                 slot[1] = self._clock()
                 slot[2] = False
                 self._last_trip[key] = slot[1]
+                tripped = True
+        if tripped:  # outside the lock: hooks must not hold breaker state
+            trace_event("breaker.trip", key=str(key))
+            _M_BREAKER_TRIPS.labels(key=str(key)).inc()
 
     def tripped(self, key) -> bool:
         return self.state(key) != "closed"

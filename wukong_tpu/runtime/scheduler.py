@@ -29,7 +29,36 @@ import threading
 import time
 
 from wukong_tpu.config import Global
+from wukong_tpu.obs.metrics import get_registry
 from wukong_tpu.utils.timer import get_usec
+
+# pool-level observability: submissions/sheds/respawns push counters; queue
+# depth is a pull gauge registered per pool (the hot loop never updates it)
+_M_SUBMITTED = get_registry().counter(
+    "wukong_pool_submitted_total", "Queries submitted to the engine pool",
+    labels=("lane",))
+_M_SHED = get_registry().counter(
+    "wukong_pool_shed_total",
+    "Queries shed from the queue with an expired deadline")
+_M_RESPAWNS = get_registry().counter(
+    "wukong_pool_engine_respawns_total", "Engine-thread crash respawns")
+
+# one registry-level queue-depth gauge summed over every LIVE pool (weakly
+# referenced: a stopped, dropped pool reads as gone, never as stale depth)
+import weakref  # noqa: E402
+
+_POOLS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def _queue_depth() -> int:
+    return sum(sum(len(dq) for dq in p.queues) + len(p.stream_queue)
+               for p in list(_POOLS))
+
+
+get_registry().gauge(
+    "wukong_pool_queue_depth",
+    "Queries waiting in pool queues (incl. stream lane)"
+).set_function(_queue_depth)
 
 
 class EnginePool:
@@ -69,6 +98,7 @@ class EnginePool:
         # an open-loop poll() consumer (the emulator) sharing this pool
         # can't steal the stream context's completions
         self._stream_qids: set = set()
+        _POOLS.add(self)  # feeds the wukong_pool_queue_depth gauge
 
     # ------------------------------------------------------------------
     def start(self) -> None:
@@ -116,6 +146,17 @@ class EnginePool:
         self._completed.append(qid)
         ev.set()
 
+    @staticmethod
+    def _end_queue_span(query, **attrs) -> None:
+        """Close a traced query's pool.queue span. Every exit from the
+        queue — popped by an engine, shed, or failed without ever being
+        popped (dead pool, stranded redistribution) — must end it, or the
+        open span keeps accruing time and swallows later trace events."""
+        qs = getattr(query, "_obs_queue_span", None)
+        if qs is not None:
+            query.trace.end_span(qs, **attrs)
+            query._obs_queue_span = None
+
     def _on_engine_death(self, tid: int, exc: BaseException) -> None:
         from wukong_tpu.utils.logger import log_error, log_warn
 
@@ -129,6 +170,7 @@ class EnginePool:
             self._fail(qid, RuntimeError(
                 f"engine-{tid} crashed executing query {qid}: {exc!r}"))
         self._respawns[tid] += 1
+        _M_RESPAWNS.inc()
         if self._respawns[tid] <= self.MAX_RESPAWNS and not self._stop.is_set():
             log_warn(f"engine-{tid} died ({exc!r}); respawning "
                      f"({self._respawns[tid]}/{self.MAX_RESPAWNS})")
@@ -148,6 +190,7 @@ class EnginePool:
             live = [t for t in range(self.n) if not self._dead[t]]
             for k, item in enumerate(stranded):
                 if not live:  # whole pool dead: fail queries, don't hang
+                    self._end_queue_span(item[1], dead_pool=True)
                     self._fail(item[0], RuntimeError("engine pool dead"))
                     continue
                 dst = live[k % len(live)]
@@ -159,6 +202,7 @@ class EnginePool:
                     stream_stranded = list(self.stream_queue)
                     self.stream_queue.clear()
                 for item in stream_stranded:
+                    self._end_queue_span(item[1], dead_pool=True)
                     self._fail(item[0], RuntimeError("engine pool dead"))
 
     # ------------------------------------------------------------------
@@ -175,11 +219,19 @@ class EnginePool:
             qid = self._next_qid
             self._next_qid += 1
             self._done[qid] = threading.Event()
+        _M_SUBMITTED.labels(lane=lane or "default").inc()
+        # traced queries get a queue span opened here and closed by the
+        # engine thread that pops them (cross-thread end is supported)
+        tr = getattr(query, "trace", None)
+        if tr is not None:
+            query._obs_queue_span = tr.start_span(
+                "pool.queue", qid=qid, lane=lane or "default")
         if lane == "stream":
             with self._results_lock:
                 self._stream_qids.add(qid)
             with self._route_lock:
                 if all(self._dead[k] for k in range(self.n)):
+                    self._end_queue_span(query, dead_pool=True)
                     self._fail(qid, RuntimeError("engine pool dead"))
                     return qid
                 with self._stream_lock:
@@ -191,6 +243,7 @@ class EnginePool:
             if self._dead[t]:  # route around dead engines
                 live = [k for k in range(self.n) if not self._dead[k]]
                 if not live:
+                    self._end_queue_span(query, dead_pool=True)
                     self._fail(qid, RuntimeError("engine pool dead"))
                     return qid
                 t = live[qid % len(live)]
@@ -283,6 +336,8 @@ class EnginePool:
             qid, query = item
             self._inflight[tid] = item
             self._busy_since[tid] = get_usec()
+            # close the queue span opened at submit (the wait IS the span)
+            self._end_queue_span(query, engine=tid)
             try:
                 # a query whose deadline expired while queued fails fast
                 # with a structured QueryTimeout instead of occupying the
@@ -292,6 +347,7 @@ class EnginePool:
                 if dl is not None and dl.expired():
                     from wukong_tpu.utils.errors import QueryTimeout
 
+                    _M_SHED.inc()
                     raise QueryTimeout(
                         f"deadline expired in engine-{tid} queue")
                 from wukong_tpu.runtime import faults

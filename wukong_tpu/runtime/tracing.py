@@ -1,49 +1,14 @@
-"""Tracing/profiling hooks (reference: Monitor micro-timing + timer sprinkles,
-SURVEY §5 — "no pervasive tracing framework").
+"""DEPRECATED: absorbed into :mod:`wukong_tpu.obs` (PR 3, observability).
 
-This build adds what the reference lacks: a scoped device profiler around any
-query (JAX profiler traces viewable in XProf/TensorBoard) and a per-step
-host-side trace recorder the engines can feed.
+``StepTrace`` now lives in ``wukong_tpu.obs.trace`` and ``device_trace`` in
+``wukong_tpu.obs.export``; the full replacement for what this module stubbed
+out is the per-query :class:`wukong_tpu.obs.QueryTrace` + flight recorder.
+This shim keeps old imports working one more release.
 """
 
 from __future__ import annotations
 
-import contextlib
-from collections import defaultdict
+from wukong_tpu.obs.export import device_trace  # noqa: F401
+from wukong_tpu.obs.trace import StepTrace  # noqa: F401
 
-from wukong_tpu.utils.timer import get_usec
-
-
-@contextlib.contextmanager
-def device_trace(logdir: str):
-    """Capture a JAX profiler trace of everything inside the block."""
-    import jax
-
-    jax.profiler.start_trace(logdir)
-    try:
-        yield
-    finally:
-        jax.profiler.stop_trace()
-
-
-class StepTrace:
-    """Per-query step timings: step label -> [usec]. Feed from engine loops."""
-
-    def __init__(self):
-        self.records: dict[str, list[int]] = defaultdict(list)
-        self._open: dict[str, int] = {}
-
-    @contextlib.contextmanager
-    def span(self, label: str):
-        t0 = get_usec()
-        try:
-            yield
-        finally:
-            self.records[label].append(get_usec() - t0)
-
-    def summary(self) -> dict[str, dict]:
-        out = {}
-        for label, xs in self.records.items():
-            out[label] = {"count": len(xs), "total_us": sum(xs),
-                          "max_us": max(xs)}
-        return out
+__all__ = ["StepTrace", "device_trace"]

@@ -53,9 +53,11 @@ class StreamContext:
             stores, continuous=self.continuous, monitor=monitor, dedup=dedup)
 
     # -- registry verbs -------------------------------------------------
-    def register(self, query, window=None, base_triples=None) -> int:
+    def register(self, query, window=None, base_triples=None,
+                 callback=None) -> int:
         return self.continuous.register(query, window=window,
-                                        base_triples=base_triples)
+                                        base_triples=base_triples,
+                                        callback=callback)
 
     def unregister(self, qid: int) -> None:
         self.continuous.unregister(qid)

@@ -23,6 +23,13 @@ emitted to an append-only per-query sink (:class:`ResultDelta`). Windowed
 queries (windows.py) evaluate against a private window store and emit
 retraction deltas when epochs retire.
 
+Push-mode sinks (PR 2 follow-up d): ``register(..., callback=fn)`` invokes
+``fn(delta)`` for every committed :class:`ResultDelta` next to the pull
+``poll()`` surface. Callback exceptions are contained by the per-query
+barrier (the epoch stays committed, the pull sink stays correct) and
+surface as the ``wukong_stream_callback_errors_total`` metric plus the
+query's ``callback_errors`` counter.
+
 Supported standing-query shapes: BGPs with FILTERs, DISTINCT-style set
 semantics, const/var subjects and objects, type patterns. Rejected at
 registration (structured errors, never silent wrong answers): UNION,
@@ -38,6 +45,8 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from wukong_tpu.config import Global
+from wukong_tpu.obs.metrics import get_registry
+from wukong_tpu.obs.trace import current as current_trace
 from wukong_tpu.planner.heuristic import heuristic_plan, plan_seeded_group
 from wukong_tpu.sparql.ir import NO_RESULT, Pattern, PatternGroup, SPARQLQuery
 from wukong_tpu.types import IN, AttrType
@@ -49,6 +58,10 @@ from wukong_tpu.utils.timer import get_usec
 # off — the lane is strictly lowest-priority, so a saturated pool could
 # otherwise block the feed forever
 STREAM_WAIT_TIMEOUT_S = 60.0
+
+_M_CB_ERRORS = get_registry().counter(
+    "wukong_stream_callback_errors_total",
+    "Push-sink callback invocations that raised (contained)")
 
 
 @dataclass
@@ -118,10 +131,12 @@ class StandingQuery:
     window: object = None  # EpochWindow | None
     wstore: object = None  # private window store (windowed queries only)
     base_triples: object = None  # static base included in window rebuilds
+    callback: object = None  # push-mode sink: fn(ResultDelta), exceptions contained
     seen: set = field(default_factory=set)
     sink: list = field(default_factory=list)  # list[ResultDelta]
     epochs_evaluated: int = 0
     degraded_epochs: int = 0  # epochs where >=1 term failed (missed results)
+    callback_errors: int = 0  # push-sink invocations that raised (contained)
     last_eval_us: int = 0
 
     def result_set(self) -> np.ndarray:
@@ -169,13 +184,20 @@ class ContinuousEngine:
     # ------------------------------------------------------------------
     # registration
     # ------------------------------------------------------------------
-    def register(self, query, window=None, base_triples=None) -> int:
+    def register(self, query, window=None, base_triples=None,
+                 callback=None) -> int:
         """Register a standing query (SPARQL text or parsed SPARQLQuery).
 
         ``window`` (WindowSpec) scopes it to the live epochs only, evaluated
         against a private window store; ``base_triples`` [N,3] are static
-        triples included in every window rebuild.
+        triples included in every window rebuild; ``callback`` is a
+        push-mode sink invoked as ``callback(delta)`` per committed
+        ResultDelta (including the registration snapshot) — exceptions are
+        contained and surfaced as a metric, never as a poisoned commit.
         """
+        if callback is not None and not callable(callback):
+            raise WukongError(ErrorCode.UNSUPPORTED_SHAPE,
+                              "callback must be callable")
         text = None
         if isinstance(query, str):
             from wukong_tpu.sparql.parser import Parser
@@ -192,7 +214,8 @@ class ContinuousEngine:
         sq = StandingQuery(
             qid=qid, proto=copy.deepcopy(query), text=text, patterns=patterns,
             required_vars=list(query.result.required_vars),
-            nvars=query.result.nvars, term_plans=term_plans)
+            nvars=query.result.nvars, term_plans=term_plans,
+            callback=callback)
         if window is not None:
             from wukong_tpu.stream.windows import EpochWindow, WindowSpec
 
@@ -307,8 +330,11 @@ class ContinuousEngine:
         """
         self.last_epoch = max(self.last_epoch, int(epoch))
         total_us = 0
+        tr = current_trace()  # the epoch trace, when ingest is traced
         for sq in list(self.queries.values()):
             t0 = get_usec()
+            sp = (tr.start_span("stream.eval_query", qid=sq.qid)
+                  if tr is not None else None)
             try:
                 if sq.window is not None:
                     self._on_epoch_windowed(sq, epoch, triples)
@@ -324,6 +350,8 @@ class ContinuousEngine:
                          f"evaluation failed: {e!r}")
             sq.epochs_evaluated += 1
             sq.last_eval_us = get_usec() - t0
+            if sp is not None:
+                tr.end_span(sp, degraded_epochs=sq.degraded_epochs)
             total_us += sq.last_eval_us
         return total_us
 
@@ -394,9 +422,24 @@ class ContinuousEngine:
         fresh = new_rows - sq.seen
         if fresh:
             sq.seen |= fresh
-            sq.sink.append(ResultDelta(
+            self._push(sq, ResultDelta(
                 epoch=epoch, sign=+1,
                 rows=np.asarray(sorted(fresh), dtype=np.int64)))
+
+    def _push(self, sq: StandingQuery, delta: ResultDelta) -> None:
+        """Commit one delta: append to the pull sink, then invoke the
+        push-mode callback (if any) with its exception contained — a bad
+        subscriber degrades to a metric, never into the epoch commit."""
+        sq.sink.append(delta)
+        if sq.callback is None:
+            return
+        try:
+            sq.callback(delta)
+        except Exception as e:
+            sq.callback_errors += 1
+            _M_CB_ERRORS.inc()
+            log_warn(f"standing query {sq.qid}: push callback failed at "
+                     f"epoch {delta.epoch}: {e!r}")
 
     def _make_delta_query(self, sq: StandingQuery, i: int, vars_: list[int],
                           seed: np.ndarray) -> SPARQLQuery:
@@ -488,11 +531,11 @@ class ContinuousEngine:
         now = self._project(q.result, sq.required_vars)
         gone, fresh = sq.seen - now, now - sq.seen
         if gone:
-            sq.sink.append(ResultDelta(
+            self._push(sq, ResultDelta(
                 epoch=epoch, sign=-1,
                 rows=np.asarray(sorted(gone), dtype=np.int64)))
         if fresh:
-            sq.sink.append(ResultDelta(
+            self._push(sq, ResultDelta(
                 epoch=epoch, sign=+1,
                 rows=np.asarray(sorted(fresh), dtype=np.int64)))
         sq.seen = now

@@ -23,9 +23,24 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from wukong_tpu.obs.metrics import get_registry
+from wukong_tpu.obs.recorder import get_recorder
+from wukong_tpu.obs.trace import activate, maybe_start_trace
 from wukong_tpu.store.dynamic import insert_triples
 from wukong_tpu.utils.errors import ErrorCode, WukongError
 from wukong_tpu.utils.timer import get_usec
+
+# stream-side metrics: committed epochs/triples as counters, per-epoch
+# latencies as histograms (the Monitor keeps its private CDF windows; the
+# registry feeds the Prometheus/JSON exporters)
+_M_EPOCHS = get_registry().counter(
+    "wukong_stream_epochs_total", "Committed stream epochs")
+_M_TRIPLES = get_registry().counter(
+    "wukong_stream_triples_total", "Triples offered to stream commits")
+_M_EVAL = get_registry().histogram(
+    "wukong_stream_eval_us", "Standing-query evaluation time per epoch")
+_M_LAG = get_registry().histogram(
+    "wukong_stream_lag_us", "Commit-to-results lag per epoch")
 
 # recent EpochRecords kept for inspection (bounds memory on long-running
 # ingest loops; the Monitor's totals/CDFs keep counting past it)
@@ -82,8 +97,16 @@ class FileSource:
     column) from a datagen-convention directory, in batches.
 
     Rows without a timestamp get the synthetic axis (batch index), matching
-    ReplaySource; a 4-column file is split into per-timestamp batches
+    ReplaySource; 4-column input is split into per-timestamp batches
     (capped at batch_size) so one epoch never mixes timestamps.
+
+    Timestamped grouping is GLOBAL across the directory (datagen
+    ``--timestamps`` writes one id_* file per source file, all spanning the
+    same epochs, and rows arrive out of order within a file) — which means
+    the 4-column path materializes every file before the first epoch is
+    emitted, a deliberate trade: correct epoch order over unsorted input
+    needs all rows, and replay directories are bounded. The 3-column path
+    streams file by file as before.
     """
 
     def __init__(self, path: str, batch_size: int = 4096):
@@ -102,28 +125,48 @@ class FileSource:
 
     def __iter__(self):
         k = 0
+        pending4: list[np.ndarray] = []  # 4-col files: grouped GLOBALLY
         for f in self._files():
             data = np.loadtxt(f, dtype=np.int64, ndmin=2)
             if data.size == 0:
                 continue
             if data.shape[1] == 3:
+                if pending4:
+                    raise WukongError(
+                        ErrorCode.UNKNOWN_PATTERN,
+                        f"{f}: 3-column file in a timestamped (4-column) "
+                        "directory — one replay cannot mix time axes")
                 for lo in range(0, len(data), self.batch_size):
                     yield float(k), data[lo:lo + self.batch_size]
                     k += 1
             elif data.shape[1] == 4:
-                ts_col = data[:, 3]
-                order = np.argsort(ts_col, kind="stable")
-                data, ts_col = data[order], ts_col[order]
-                uts, starts = np.unique(ts_col, return_index=True)
-                bounds = np.append(starts, len(data))
-                for t, lo, hi in zip(uts, bounds[:-1], bounds[1:]):
-                    for blo in range(int(lo), int(hi), self.batch_size):
-                        yield float(t), data[blo:min(blo + self.batch_size, hi), :3]
+                if k:
+                    raise WukongError(
+                        ErrorCode.UNKNOWN_PATTERN,
+                        f"{f}: 4-column file in a synthetic-axis (3-column) "
+                        "directory — one replay cannot mix time axes")
+                # don't yield yet: datagen --timestamps writes one id_*
+                # file per source file, each spanning the SAME epochs, so
+                # per-file grouping would re-emit a timestamp once per
+                # file (splitting one epoch and breaking window
+                # retirement order). Collect, then sort/group globally.
+                pending4.append(data)
             else:
                 raise WukongError(
                     ErrorCode.UNKNOWN_PATTERN,
                     f"{f}: want 3 (s p o) or 4 (s p o ts) columns, "
                     f"got {data.shape[1]}")
+        if pending4:
+            data = np.concatenate(pending4)
+            ts_col = data[:, 3]
+            order = np.argsort(ts_col, kind="stable")
+            data, ts_col = data[order], ts_col[order]
+            uts, starts = np.unique(ts_col, return_index=True)
+            bounds = np.append(starts, len(data))
+            for t, lo, hi in zip(uts, bounds[:-1], bounds[1:]):
+                for blo in range(int(lo), int(hi), self.batch_size):
+                    yield (float(t),
+                           data[blo:min(blo + self.batch_size, hi), :3])
 
 
 class StreamIngestor:
@@ -148,8 +191,6 @@ class StreamIngestor:
         """Insert one batch as the next epoch, then evaluate standing
         queries on its delta. Returns the epoch's record."""
         from wukong_tpu.runtime import faults
-        from wukong_tpu.runtime.faults import TransientFault
-        from wukong_tpu.runtime.resilience import retry_call
         from wukong_tpu.store.gstore import check_vid_range
 
         triples = np.asarray(triples, dtype=np.int64)
@@ -157,6 +198,9 @@ class StreamIngestor:
             raise WukongError(ErrorCode.UNKNOWN_PATTERN,
                               f"epoch batch wants [N,3], got {triples.shape}")
         check_vid_range(triples)  # once per epoch, not per store
+        # per-epoch trace (the stream lane's unit of work): ingest + eval
+        # spans, recorded into the same flight recorder as query traces
+        trace = maybe_start_trace(kind="stream")
         t0 = get_usec()
 
         inserted = [0]  # accumulated across retry attempts: a store that
@@ -170,28 +214,51 @@ class StreamIngestor:
                                               check_ids=False)
             return inserted[0]
 
-        if self.dedup:
-            # idempotent under dedup: a replayed batch re-drops as duplicate
-            n_ins = retry_call(_ingest, site="stream.ingest",
-                               retry_on=(TransientFault, OSError))
-        else:
-            n_ins = _ingest()
+        with activate(trace):
+            if trace is None:
+                n_ins = self._commit(_ingest)
+            else:
+                with trace.span("stream.ingest", n_triples=len(triples)):
+                    n_ins = self._commit(_ingest)
 
-        self.epoch += 1
-        rec = EpochRecord(
-            epoch=self.epoch,
-            ts=float(ts) if ts is not None else float(self.epoch),
-            n_triples=len(triples), n_inserted=n_ins,
-            version=getattr(self.stores[0], "version", 0),
-            ingest_us=get_usec() - t0)
-        if self.continuous is not None:
-            rec.eval_us = self.continuous.on_epoch(self.epoch, triples, rec.ts)
+            self.epoch += 1
+            rec = EpochRecord(
+                epoch=self.epoch,
+                ts=float(ts) if ts is not None else float(self.epoch),
+                n_triples=len(triples), n_inserted=n_ins,
+                version=getattr(self.stores[0], "version", 0),
+                ingest_us=get_usec() - t0)
+            if self.continuous is not None:
+                if trace is None:
+                    rec.eval_us = self.continuous.on_epoch(
+                        self.epoch, triples, rec.ts)
+                else:
+                    with trace.span("stream.eval", epoch=self.epoch):
+                        rec.eval_us = self.continuous.on_epoch(
+                            self.epoch, triples, rec.ts)
         if self.monitor is not None:
             self.monitor.record_stream_epoch(
                 n_triples=rec.n_triples, ingest_us=rec.ingest_us,
                 eval_us=rec.eval_us, lag_us=rec.lag_us)
+        _M_EPOCHS.inc()
+        _M_TRIPLES.inc(rec.n_triples)
+        _M_EVAL.observe(rec.eval_us)
+        _M_LAG.observe(rec.lag_us)
+        if trace is not None:
+            trace.qid = self.epoch  # epoch number IS the stream qid
+            get_recorder().on_complete(trace)
         self.log.append(rec)
         return rec
+
+    def _commit(self, _ingest) -> int:
+        from wukong_tpu.runtime.faults import TransientFault
+        from wukong_tpu.runtime.resilience import retry_call
+
+        if self.dedup:
+            # idempotent under dedup: a replayed batch re-drops as duplicate
+            return retry_call(_ingest, site="stream.ingest",
+                              retry_on=(TransientFault, OSError))
+        return _ingest()
 
     def ingest(self, source, max_epochs: int | None = None) -> list[EpochRecord]:
         """Drain a TripleSource (or any (ts, batch) iterable) into epochs."""

@@ -33,8 +33,7 @@ def setup_persistent_cache(cache_dir: str | None = None) -> str | None:
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
         return cache_dir
     except Exception as e:
-        import sys
+        from wukong_tpu.utils.logger import log_warn
 
-        print(f"# persistent compilation cache unavailable: {e}",
-              file=sys.stderr)
+        log_warn(f"persistent compilation cache unavailable: {e}")
         return None
