@@ -524,6 +524,88 @@ def emu_main(device_ok: bool) -> None:
     }, "BENCH_EMU_DETAIL.json")
 
 
+def serve_main(device_ok: bool) -> None:
+    """`bench.py --serve-batched`: serving-path throughput before/after
+    continuous micro-batching (runtime/batcher.py) on a same-template
+    open-loop workload — closed-loop client threads submitting query TEXTS
+    through proxy.serve_query (parse cache -> plan cache -> batcher or
+    direct engine). The OFF number is the seed serving path; the ON number
+    coalesces compatible queries into fused chain dispatches. Artifact:
+    BENCH_SERVE.json with both numbers and the speedup."""
+    import numpy as np
+
+    from wukong_tpu.config import Global
+    from wukong_tpu.engine.cpu import CPUEngine
+    from wukong_tpu.engine.tpu import TPUEngine
+    from wukong_tpu.loader.lubm import UB
+    from wukong_tpu.planner.optimizer import Planner
+    from wukong_tpu.runtime.emulator import Emulator
+    from wukong_tpu.runtime.proxy import Proxy
+    from wukong_tpu.types import OUT
+
+    scale = int(os.environ.get("WUKONG_BENCH_SCALE", "0")) or 1
+    g, ss, stats = _ensure_world(scale)
+    proxy = Proxy(g, ss, cpu_engine=CPUEngine(g, ss),
+                  tpu_engine=TPUEngine(g, ss, stats=stats),
+                  planner=Planner(stats))
+    # the default serving route (device engine when enable_tpu) on a light
+    # same-template class: one device dispatch per query unbatched, one per
+    # GROUP batched — the serving-path analogue of the emulator's
+    # device-batch win. WUKONG_SERVE_HOST=1 pins the host engines instead.
+    if os.environ.get("WUKONG_SERVE_HOST") == "1":
+        Global.enable_tpu = False
+    pid = ss.str2id(f"<{UB}advisor>")
+    anchors = np.asarray(g.get_index(pid, OUT))
+    texts = [f"SELECT ?s WHERE {{ ?s <{UB}advisor> "
+             f"{ss.id2str(int(a))} . }}" for a in anchors[:512]]
+    dur = float(os.environ.get("WUKONG_SERVE_DURATION", "10"))
+    clients = int(os.environ.get("WUKONG_SERVE_CLIENTS", "16"))
+    emu = Emulator(proxy)
+    for t in texts[:8]:  # warm parse/plan caches + engine jit shapes
+        proxy.serve_query(t, blind=True)
+
+    Global.enable_batching = False
+    off = emu.run_serving(texts, duration_s=dur, warmup_s=1.0,
+                          clients=clients, seed=1)
+    Global.enable_batching = True
+    on = emu.run_serving(texts, duration_s=dur, warmup_s=1.0,
+                         clients=clients, seed=1)
+    Global.enable_batching = False
+    speedup = round(on["qps"] / off["qps"], 2) if off["qps"] else None
+    from wukong_tpu.obs import get_registry
+
+    snap = get_registry().snapshot()
+    batch_metrics = {
+        name: [{**s["labels"], "value": s["value"]}
+               for s in snap.get(name, {}).get("series", [])]
+        for name in ("wukong_batch_flush_total", "wukong_batch_bypass_total",
+                     "wukong_batch_fallback_total",
+                     "wukong_batch_fused_queries_total")}
+    occ = snap.get("wukong_batch_occupancy", {}).get("series", [])
+    mean_occ = (round(occ[0]["sum"] / occ[0]["count"], 2)
+                if occ and occ[0].get("count") else None)
+    _emit_final({
+        "metric": f"LUBM-{scale} serving-path throughput, {clients} clients "
+                  f"x {dur:.0f}s same-template closed loop "
+                  "(batched vs unbatched serving, device-engine route)",
+        "value": on["qps"],
+        "unit": "q/s",
+        "unbatched_qps": off["qps"],
+        "batched_qps": on["qps"],
+        "speedup": speedup,
+        "backend": "tpu" if device_ok else "cpu",
+        "detail": {
+            "before": off, "after": on,
+            "knobs": {"batch_window_us": Global.batch_window_us,
+                      "batch_max_size": Global.batch_max_size,
+                      "clients": clients, "scale": scale},
+            "mean_batch_occupancy": mean_occ,
+            "batch_metrics": batch_metrics,
+            "dataset": DATASET_NOTES["lubm"],
+        },
+    }, "BENCH_SERVE.json")
+
+
 def watdiv_main(device_ok: bool) -> None:
     """`bench.py --watdiv`: S1-S7/F1-F5 star/snowflake templates, batched
     (BASELINE.json configs[3] — no published reference number for this
@@ -1661,6 +1743,9 @@ def main():
         jax.config.update("jax_platforms", "cpu")
     if "--micro" in sys.argv:
         micro_main(device_ok)
+        return
+    if "--serve-batched" in sys.argv:
+        serve_main(device_ok)
         return
     if "--emu" in sys.argv:
         emu_main(device_ok)

@@ -1,18 +1,24 @@
 #!/usr/bin/env python
-"""Observability sanity gate: no bare ``print(`` in wukong_tpu/ library code.
+"""Observability + serving-path sanity gates for wukong_tpu/ library code.
 
-Everything in the library reports through the leveled logger
-(utils/logger.py) or the metrics registry (obs/metrics.py) — stdout belongs
-to report surfaces only. Allowed:
+Gate 1 — no bare ``print(``: everything in the library reports through the
+leveled logger (utils/logger.py) or the metrics registry (obs/metrics.py) —
+stdout belongs to report surfaces only. Allowed:
 
 - ``runtime/console.py`` and ``runtime/monitor.py`` (the interactive
   console and the rolling report are stdout surfaces by design)
 - calls lexically inside a function named ``main`` (CLI entry points:
   datagen/lubm emit their JSON meta to stdout like any Unix tool)
 
+Gate 2 — no direct ``engine.execute(`` under ``runtime/`` outside the
+allowlisted bypass sites: interactive dispatches must flow through
+``Proxy._serve_execute`` (the batcher entry point, runtime/batcher.py) so
+future code can't silently reopen a one-query-per-dispatch path next to the
+coalescer. The allowlist names the sites that ARE the serving machinery.
+
 Run standalone (``python scripts/lint_obs.py``) or via the test suite
-(tests/test_obs.py::test_lint_obs_gate). Exit code 1 + one line per
-violation when the gate fails.
+(tests/test_obs.py::test_lint_obs_gate, tests/test_batcher.py). Exit code 1
++ one line per violation when a gate fails.
 """
 
 from __future__ import annotations
@@ -26,6 +32,16 @@ ALLOWED_FILES = {
     os.path.join("runtime", "monitor.py"),
 }
 ALLOWED_FUNCS = {"main"}
+
+# (runtime-relative file, enclosing function) pairs allowed to call
+# ``<obj>.execute(...)`` directly — the serving machinery itself
+EXECUTE_ALLOWLIST = {
+    ("proxy.py", "_serve_execute"),   # THE batcher entry / bypass site
+    ("proxy.py", "_run_repeats"),     # shape/capacity degradation re-runs
+    ("scheduler.py", "_engine_loop"),  # pool engines executing popped work
+    ("batcher.py", "_run_single"),    # per-query fallback of a fused group
+    ("batcher.py", "_run_fused"),     # the fused dispatch itself
+}
 
 
 class _PrintFinder(ast.NodeVisitor):
@@ -47,6 +63,27 @@ class _PrintFinder(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+class _ExecuteFinder(ast.NodeVisitor):
+    """Direct ``<obj>.execute(...)`` calls with their enclosing function."""
+
+    def __init__(self):
+        self.func_stack: list[str] = []
+        self.hits: list[tuple[int, str]] = []  # (lineno, enclosing func)
+
+    def visit_FunctionDef(self, node):
+        self.func_stack.append(node.name)
+        self.generic_visit(node)
+        self.func_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node):
+        if isinstance(node.func, ast.Attribute) and node.func.attr == "execute":
+            self.hits.append(
+                (node.lineno, self.func_stack[-1] if self.func_stack else ""))
+        self.generic_visit(node)
+
+
 def violations(pkg_root: str) -> list[str]:
     out: list[str] = []
     for dirpath, _dirs, files in os.walk(pkg_root):
@@ -55,19 +92,27 @@ def violations(pkg_root: str) -> list[str]:
                 continue
             path = os.path.join(dirpath, fn)
             rel = os.path.relpath(path, pkg_root)
-            if rel in ALLOWED_FILES:
-                continue
             with open(path) as f:
                 try:
                     tree = ast.parse(f.read(), filename=path)
                 except SyntaxError as e:
                     out.append(f"{rel}: syntax error: {e}")
                     continue
-            finder = _PrintFinder()
-            finder.visit(tree)
-            out.extend(f"{rel}:{ln}: bare print() in library code "
-                       "(use utils.logger or obs.metrics)"
-                       for ln in finder.hits)
+            if rel not in ALLOWED_FILES:
+                finder = _PrintFinder()
+                finder.visit(tree)
+                out.extend(f"{rel}:{ln}: bare print() in library code "
+                           "(use utils.logger or obs.metrics)"
+                           for ln in finder.hits)
+            if os.path.basename(dirpath) == "runtime":
+                ef = _ExecuteFinder()
+                ef.visit(tree)
+                out.extend(
+                    f"{rel}:{ln}: direct engine.execute() bypasses the "
+                    "batcher entry point (route through "
+                    "Proxy._serve_execute or extend EXECUTE_ALLOWLIST)"
+                    for ln, func in ef.hits
+                    if (fn, func) not in EXECUTE_ALLOWLIST)
     return out
 
 

@@ -1,0 +1,532 @@
+"""Serving-path micro-batching (runtime/batcher.py) + parse/plan caches.
+
+Pins the PR's contract: batched execution is byte-identical to sequential
+execution (and to the independent BGP oracle), flushes happen on window age
+vs size, deadline-tight and incompatible queries bypass, a mid-batch
+deadline/budget event degrades only the affected member, a failing fused
+dispatch falls back per-query (and trips the batch breaker), and the plan
+cache invalidates on dynamic inserts / stream commits.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from wukong_tpu.config import Global
+from wukong_tpu.engine.cpu import CPUEngine
+from wukong_tpu.engine.tpu import TPUEngine
+from wukong_tpu.loader.lubm import UB, VirtualLubmStrings, generate_lubm
+from wukong_tpu.runtime import batcher as B
+from wukong_tpu.runtime.batcher import (
+    FusedGroup,
+    QueryBatcher,
+    _Pending,
+    batchable,
+    fused_key,
+    template_signature,
+)
+from wukong_tpu.runtime.proxy import Proxy
+from wukong_tpu.runtime.resilience import Deadline
+from wukong_tpu.store.gstore import build_partition
+from wukong_tpu.utils.errors import ErrorCode
+from wukong_tpu.utils.lru import LRUCache
+
+pytestmark = pytest.mark.batch
+
+
+@pytest.fixture(scope="module")
+def world():
+    triples, _ = generate_lubm(1, seed=42)
+    g = build_partition(triples, 0, 1)
+    ss = VirtualLubmStrings(1, seed=42)
+    proxy = Proxy(g, ss, CPUEngine(g, ss), TPUEngine(g, ss))
+    return {"g": g, "ss": ss, "proxy": proxy, "triples": triples}
+
+
+@pytest.fixture(autouse=True)
+def _batching_off_after(monkeypatch):
+    """Every test starts and ends with the default (batching off)."""
+    monkeypatch.setattr(Global, "enable_batching", False)
+    yield
+
+
+def _texts(world, n=6, shape="chain"):
+    """Same-template query texts differing only in the start constant."""
+    ss, g = world["ss"], world["g"]
+    from wukong_tpu.types import OUT
+
+    pid = ss.str2id(f"<{UB}memberOf>")
+    depts = np.asarray(g.get_index(pid, OUT))[:n]
+    out = []
+    for d in depts:
+        diri = ss.id2str(int(d))
+        if shape == "const":
+            out.append(f"SELECT ?s WHERE {{ ?s <{UB}memberOf> {diri} . }}")
+        elif shape == "chain":
+            out.append(
+                f"SELECT ?s ?c WHERE {{ ?s <{UB}memberOf> {diri} . "
+                f"?s <{UB}takesCourse> ?c . }}")
+        elif shape == "filter":
+            out.append(
+                f"SELECT ?s ?c WHERE {{ ?s <{UB}memberOf> {diri} . "
+                f"?s <{UB}takesCourse> ?c . FILTER (?s != ?c) }}")
+        else:
+            raise AssertionError(shape)
+    return out
+
+
+def _planned(proxy, text, blind=True, deadline=None):
+    """A parsed+planned query, serving-path style (no execution)."""
+    q = proxy._parse_text(text)
+    proxy._plan_prepared(q, blind, None)
+    q.deadline = deadline
+    return q
+
+
+# ---------------------------------------------------------------------------
+# result fidelity
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("shape", ["const", "chain", "filter"])
+def test_batched_byte_identical_to_sequential(world, monkeypatch, shape):
+    proxy = world["proxy"]
+    texts = _texts(world, n=6, shape=shape)
+    seq = [proxy.serve_query(t, blind=False) for t in texts]
+    seq_tables = [np.asarray(q.result.table) for q in seq]
+    assert all(q.result.status_code == ErrorCode.SUCCESS for q in seq)
+    assert any(len(t) for t in seq_tables)
+
+    monkeypatch.setattr(Global, "enable_batching", True)
+    monkeypatch.setattr(Global, "batch_window_us", 100_000)
+    out = [None] * len(texts)
+
+    def go(i):
+        out[i] = proxy.serve_query(texts[i], blind=False)
+
+    ths = [threading.Thread(target=go, args=(i,)) for i in range(len(texts))]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join()
+    for i, q in enumerate(out):
+        assert q.result.status_code == ErrorCode.SUCCESS
+        assert np.array_equal(np.asarray(q.result.table), seq_tables[i]), i
+        assert q.result.v2c_map == seq[i].result.v2c_map
+
+
+def test_batched_matches_oracle(world, monkeypatch):
+    """Fused results == the independent index-nested-loop oracle."""
+    from tests.bgp_oracle import TripleIndex, eval_bgp
+
+    proxy, ss = world["proxy"], world["ss"]
+    idx = TripleIndex(world["triples"])
+    pid_m = ss.str2id(f"<{UB}memberOf>")
+    pid_t = ss.str2id(f"<{UB}takesCourse>")
+    texts = _texts(world, n=4, shape="chain")
+    from wukong_tpu.types import OUT
+
+    depts = np.asarray(world["g"].get_index(pid_m, OUT))[:4]
+
+    monkeypatch.setattr(Global, "enable_batching", True)
+    monkeypatch.setattr(Global, "batch_window_us", 100_000)
+    out = [None] * len(texts)
+
+    def go(i):
+        out[i] = proxy.serve_query(texts[i], blind=False)
+
+    ths = [threading.Thread(target=go, args=(i,)) for i in range(len(texts))]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join()
+    for i, q in enumerate(out):
+        # oracle patterns as written: ?s memberOf <dept> . ?s takes ?c
+        want = sorted(eval_bgp(idx, [(-1, pid_m, int(depts[i])),
+                                     (-1, pid_t, -2)], [-1, -2]))
+        got = sorted(tuple(int(x) for x in row)
+                     for row in np.asarray(q.result.table))
+        assert got == want, i
+
+
+# ---------------------------------------------------------------------------
+# coalescing mechanics: flush reasons, bypasses
+# ---------------------------------------------------------------------------
+
+def _counter(name, **labels):
+    from wukong_tpu.obs import get_registry
+
+    m = get_registry()._metrics.get(name)
+    if m is None:
+        return 0.0
+    return m.value(**labels) if labels else m.value()
+
+
+def _hold_inflight(bt):
+    """Pretend a dispatch is executing, so offers accumulate instead of
+    idle-flushing — the deterministic stand-in for concurrent load."""
+    with bt._lock:
+        bt._inflight += 1
+
+
+def _release_inflight(bt):
+    with bt._lock:
+        bt._inflight = max(bt._inflight - 1, 0)
+
+
+def test_flush_on_size(world, monkeypatch):
+    proxy = world["proxy"]
+    monkeypatch.setattr(Global, "enable_batching", True)
+    monkeypatch.setattr(Global, "batch_window_us", 10_000_000)
+    monkeypatch.setattr(Global, "batch_max_size", 4)
+    bt = proxy.batcher()
+    _hold_inflight(bt)  # a "running dispatch": arrivals must accumulate
+    try:
+        before = _counter("wukong_batch_flush_total", reason="size")
+        texts = _texts(world, n=4, shape="chain")
+        pends = [bt.offer(_planned(proxy, t)) for t in texts]
+        assert all(p is not None for p in pends)
+        for p in pends:  # the 4th offer flushed the group synchronously
+            p.wait(timeout=30)
+        assert _counter("wukong_batch_flush_total",
+                        reason="size") == before + 1
+        assert all(p.q.result.status_code == ErrorCode.SUCCESS
+                   for p in pends)
+    finally:
+        _release_inflight(bt)
+
+
+def test_flush_on_window_timeout(world, monkeypatch):
+    proxy = world["proxy"]
+    monkeypatch.setattr(Global, "enable_batching", True)
+    monkeypatch.setattr(Global, "batch_window_us", 20_000)
+    monkeypatch.setattr(Global, "batch_max_size", 64)
+    bt = proxy.batcher()
+    _hold_inflight(bt)  # arrivals accumulate behind the "running" dispatch
+    try:
+        before = _counter("wukong_batch_flush_total", reason="window")
+        p = bt.offer(_planned(proxy, _texts(world, n=1)[0]))
+        assert p is not None
+        p.wait(timeout=30)  # nobody joined: the window must release it
+        assert _counter("wukong_batch_flush_total",
+                        reason="window") >= before + 1
+        assert p.q.result.status_code == ErrorCode.SUCCESS
+    finally:
+        _release_inflight(bt)
+
+
+def test_idle_flush_skips_window(world, monkeypatch):
+    """Nothing executing, nothing queued: a lone query dispatches
+    immediately (reason=idle) instead of waiting out the window."""
+    proxy = world["proxy"]
+    monkeypatch.setattr(Global, "enable_batching", True)
+    monkeypatch.setattr(Global, "batch_window_us", 5_000_000)
+    bt = proxy.batcher()
+    before = _counter("wukong_batch_flush_total", reason="idle")
+    t0 = time.monotonic()
+    p = bt.offer(_planned(proxy, _texts(world, n=1)[0]))
+    assert p is not None
+    p.wait(timeout=30)
+    assert time.monotonic() - t0 < 4  # never saw the 5s window
+    assert _counter("wukong_batch_flush_total", reason="idle") == before + 1
+    assert p.q.result.status_code == ErrorCode.SUCCESS
+
+
+def test_deadline_tight_bypasses(world, monkeypatch):
+    proxy = world["proxy"]
+    monkeypatch.setattr(Global, "enable_batching", True)
+    monkeypatch.setattr(Global, "batch_window_us", 50_000)
+    bt = proxy.batcher()
+    q = _planned(proxy, _texts(world, n=1)[0],
+                 deadline=Deadline(timeout_ms=50))  # < 4x window
+    before = _counter("wukong_batch_bypass_total", reason="deadline")
+    assert bt.offer(q) is None
+    assert _counter("wukong_batch_bypass_total",
+                    reason="deadline") == before + 1
+
+
+def test_row_budget_bypasses(world, monkeypatch):
+    """Per-step row budgets can't be attributed inside a fused chain —
+    budgeted queries keep exact sequential enforcement."""
+    proxy = world["proxy"]
+    monkeypatch.setattr(Global, "enable_batching", True)
+    bt = proxy.batcher()
+    q = _planned(proxy, _texts(world, n=1)[0],
+                 deadline=Deadline(budget_rows=100))
+    before = _counter("wukong_batch_bypass_total", reason="budget")
+    assert bt.offer(q) is None
+    assert _counter("wukong_batch_bypass_total",
+                    reason="budget") == before + 1
+
+
+def test_device_pin_bypasses_batcher(world, monkeypatch):
+    """An explicit device= request must not be silently rerouted onto the
+    batcher's engine choice."""
+    proxy = world["proxy"]
+    monkeypatch.setattr(Global, "enable_batching", True)
+    offered = []
+    orig = type(proxy.batcher()).offer
+
+    def spy(self, q):
+        offered.append(q)
+        return orig(self, q)
+
+    monkeypatch.setattr(type(proxy.batcher()), "offer", spy)
+    q = proxy.run_single_query(_texts(world, n=1)[0], device="cpu",
+                               blind=True)
+    assert q.result.status_code == ErrorCode.SUCCESS
+    assert offered == []  # pinned: never entered the batcher
+
+
+def test_incompatible_shapes_bypass(world):
+    proxy = world["proxy"]
+    bt = proxy.batcher()
+    # index-origin query: no const start -> must bypass untouched
+    q = _planned(proxy, "SELECT ?x WHERE { ?x "
+                 "<http://www.w3.org/1999/02/22-rdf-syntax-ns#type> "
+                 f"<{UB}FullProfessor> . }}")
+    assert not batchable(q)
+    before = _counter("wukong_batch_bypass_total", reason="shape")
+    assert bt.offer(q) is None
+    assert _counter("wukong_batch_bypass_total", reason="shape") == before + 1
+    # and through the proxy, the bypass still executes correctly
+    out = proxy.serve_query(
+        "SELECT ?x WHERE { ?x "
+        "<http://www.w3.org/1999/02/22-rdf-syntax-ns#type> "
+        f"<{UB}FullProfessor> . }}", blind=True)
+    assert out.result.status_code == ErrorCode.SUCCESS
+    assert out.result.nrows > 0
+
+
+def test_fused_key_groups_only_same_template(world):
+    proxy = world["proxy"]
+    chain = [_planned(proxy, t) for t in _texts(world, n=2, shape="chain")]
+    const = [_planned(proxy, t) for t in _texts(world, n=2, shape="const")]
+    filt = [_planned(proxy, t) for t in _texts(world, n=2, shape="filter")]
+    assert fused_key(chain[0]) == fused_key(chain[1])
+    assert fused_key(const[0]) == fused_key(const[1])
+    assert fused_key(chain[0]) != fused_key(const[0])
+    assert fused_key(chain[0]) != fused_key(filt[0])  # filters differ
+    assert template_signature(chain[0]) == template_signature(chain[1])
+
+
+# ---------------------------------------------------------------------------
+# per-member resilience inside a fused dispatch
+# ---------------------------------------------------------------------------
+
+def test_member_deadline_degrades_only_that_member(world, monkeypatch):
+    proxy = world["proxy"]
+    texts = _texts(world, n=3, shape="chain")
+    bt = proxy.batcher()
+    t_frozen = [0.0]
+    expired = Deadline(timeout_ms=1, clock=lambda: t_frozen[0])
+    t_frozen[0] = 10.0  # expired before the flush
+    members = [
+        _Pending(_planned(proxy, texts[0], blind=False)),
+        _Pending(_planned(proxy, texts[1], blind=False, deadline=expired)),
+        _Pending(_planned(proxy, texts[2], blind=False)),
+    ]
+    FusedGroup(members, bt, engine=None).run(None)
+    ok0, bad, ok2 = (m.q.result for m in members)
+    assert ok0.status_code == ErrorCode.SUCCESS and ok0.nrows > 0
+    assert ok2.status_code == ErrorCode.SUCCESS and ok2.nrows > 0
+    assert bad.status_code == ErrorCode.QUERY_TIMEOUT
+    assert not bad.complete
+
+
+def test_member_budget_charged_per_member(world):
+    """A fused dispatch charges each member its own rows: the tiny-budget
+    member degrades to a partial result, co-members are untouched."""
+    proxy = world["proxy"]
+    texts = _texts(world, n=2, shape="chain")
+    bt = proxy.batcher()
+    members = [
+        _Pending(_planned(proxy, texts[0], blind=False)),
+        _Pending(_planned(proxy, texts[1], blind=False,
+                          deadline=Deadline(budget_rows=1))),
+    ]
+    FusedGroup(members, bt, engine=None).run(None)
+    ok, bad = (m.q.result for m in members)
+    assert ok.status_code == ErrorCode.SUCCESS and ok.nrows > 0
+    assert bad.status_code == ErrorCode.BUDGET_EXCEEDED
+    assert not bad.complete
+
+
+def test_fused_failure_falls_back_per_query(world, monkeypatch):
+    """A failing fused dispatch degrades to per-query execution: every
+    member still gets its correct result."""
+    proxy = world["proxy"]
+    texts = _texts(world, n=3, shape="chain")
+    seq_rows = [proxy.serve_query(t, blind=True).result.nrows for t in texts]
+    bt = QueryBatcher(proxy.cpu, None)
+    try:
+        monkeypatch.setattr(
+            FusedGroup, "_run_fused",
+            lambda self, live, engine: (_ for _ in ()).throw(
+                RuntimeError("chain exploded")))
+        before = _counter("wukong_batch_fallback_total",
+                          reason="dispatch_error")
+        members = [_Pending(_planned(proxy, t)) for t in texts]
+        FusedGroup(members, bt, engine=None).run(None)
+        assert _counter("wukong_batch_fallback_total",
+                        reason="dispatch_error") == before + 1
+        for m, want in zip(members, seq_rows):
+            assert m.q.result.status_code == ErrorCode.SUCCESS
+            assert m.q.result.nrows == want
+    finally:
+        bt.close()
+
+
+def test_breaker_opens_after_repeated_fused_failures(world, monkeypatch):
+    """Consecutive fused failures open the batch breaker; while open,
+    groups go straight to per-query execution without attempting the
+    fused dispatch."""
+    proxy = world["proxy"]
+    texts = _texts(world, n=2, shape="chain")
+    bt = QueryBatcher(proxy.cpu, None)
+    try:
+        calls = []
+
+        def boom(self, live, engine):
+            calls.append(len(live))
+            raise RuntimeError("chain exploded")
+
+        monkeypatch.setattr(FusedGroup, "_run_fused", boom)
+        for _ in range(Global.breaker_threshold):
+            members = [_Pending(_planned(proxy, t)) for t in texts]
+            FusedGroup(members, bt, engine=None).run(None)
+        assert len(calls) == Global.breaker_threshold
+        assert bt.breaker.state("batch.dispatch") == "open"
+        before = _counter("wukong_batch_fallback_total",
+                          reason="breaker_open")
+        members = [_Pending(_planned(proxy, t)) for t in texts]
+        FusedGroup(members, bt, engine=None).run(None)
+        assert len(calls) == Global.breaker_threshold  # fused NOT attempted
+        assert _counter("wukong_batch_fallback_total",
+                        reason="breaker_open") == before + 1
+        for m in members:  # still served, per-query
+            assert m.q.result.status_code == ErrorCode.SUCCESS
+    finally:
+        bt.close()
+
+
+# ---------------------------------------------------------------------------
+# scheduler batch lane
+# ---------------------------------------------------------------------------
+
+def test_batch_lane_executes_group_as_unit(world):
+    proxy = world["proxy"]
+    pool = proxy.engine_pool()
+    bt = proxy.batcher()
+    texts = _texts(world, n=4, shape="chain")
+    members = [_Pending(_planned(proxy, t)) for t in texts]
+    group = FusedGroup(members, bt, engine=None)
+    assert pool.submit(group, lane="batch") == -1
+    for m in members:
+        m.wait(timeout=30)
+        assert m.q.result.status_code == ErrorCode.SUCCESS
+    # fire-and-forget: no stranded pool completions for poll() consumers
+    assert pool.poll() == []
+
+
+# ---------------------------------------------------------------------------
+# parse/plan caches
+# ---------------------------------------------------------------------------
+
+def test_parse_and_plan_cache_hit(world):
+    proxy = world["proxy"]
+    text = _texts(world, n=1)[0]
+    h0 = proxy._parse_cache.hits
+    p0 = proxy._plan_cache.stats()["hits"]
+    proxy.serve_query(text, blind=True)
+    proxy.serve_query(text, blind=True)
+    assert proxy._parse_cache.hits > h0
+    assert proxy._plan_cache.stats()["hits"] > p0
+
+
+def test_plan_cache_shared_across_same_template(world):
+    """Different constants, same template: the second query replays the
+    first's plan recipe instead of replanning."""
+    proxy = world["proxy"]
+    t1, t2 = _texts(world, n=2, shape="chain")
+    proxy._plan_cache.clear()
+    proxy.serve_query(t1, blind=True)
+    h0 = proxy._plan_cache.stats()["hits"]
+    q2 = proxy.serve_query(t2, blind=True)
+    assert proxy._plan_cache.stats()["hits"] == h0 + 1
+    assert q2.result.status_code == ErrorCode.SUCCESS
+
+
+def test_plan_cache_invalidated_on_dynamic_insert(world):
+    """A store-version bump (dynamic insert / stream commit both go through
+    insert_triples) makes every cached plan key stale: the next query
+    re-plans instead of replaying."""
+    from wukong_tpu.store.dynamic import insert_triples
+
+    proxy, g = world["proxy"], world["g"]
+    text = _texts(world, n=1)[0]
+    proxy.serve_query(text, blind=True)
+    m0 = proxy._plan_cache.stats()["misses"]
+    proxy.serve_query(text, blind=True)
+    assert proxy._plan_cache.stats()["misses"] == m0  # warm: replayed
+    # re-insert an existing edge with dedup: zero data change, version bump
+    tri = world["triples"][:1].copy()
+    assert insert_triples(g, tri, dedup=True) == 0
+    q = proxy.serve_query(text, blind=True)
+    assert proxy._plan_cache.stats()["misses"] == m0 + 1  # stale key: replan
+    assert q.result.status_code == ErrorCode.SUCCESS
+
+
+def test_dynamic_load_clears_plan_cache(world, tmp_path):
+    proxy = world["proxy"]
+    text = _texts(world, n=1)[0]
+    proxy.serve_query(text, blind=True)
+    assert len(proxy._plan_cache._lru) > 0
+    np.save(tmp_path / "id_triples.npy", world["triples"][:1])
+    proxy.dynamic_load_data(str(tmp_path), check_dup=True)
+    assert len(proxy._plan_cache._lru) == 0
+
+
+# ---------------------------------------------------------------------------
+# satellites: LRU est-cache, lint gate
+# ---------------------------------------------------------------------------
+
+def test_lru_cache_bounded_and_recency():
+    lru = LRUCache(maxsize=3)
+    for k in range(3):
+        lru.put(k, k * 10)
+    assert lru.get(0) == 0  # refresh 0's recency
+    lru.put(3, 30)  # evicts 1 (coldest), not 0
+    assert lru.get(0) == 0 and lru.get(3) == 30
+    assert lru.get(1) is None
+    assert len(lru) == 3
+
+
+def test_est_cache_is_bounded_lru(world):
+    eng = world["proxy"].tpu
+    assert isinstance(eng._est_cache, LRUCache)
+    assert eng._est_cache.maxsize == 4096
+
+
+def test_lint_gate_flags_batcher_bypass(tmp_path):
+    import importlib.util
+    import os
+    import sys
+
+    spec = importlib.util.spec_from_file_location(
+        "lint_obs", os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "scripts", "lint_obs.py"))
+    lint = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lint)
+    # the real tree is clean
+    pkg = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "wukong_tpu")
+    assert lint.violations(pkg) == []
+    # an un-allowlisted direct execute under runtime/ is flagged
+    rt = tmp_path / "runtime"
+    rt.mkdir()
+    (rt / "sneaky.py").write_text(
+        "def fast_path(eng, q):\n    return eng.execute(q)\n")
+    bad = lint.violations(str(tmp_path))
+    assert len(bad) == 1 and "batcher entry point" in bad[0]

@@ -11,6 +11,7 @@ golden exposition format.
 import os
 import subprocess
 import sys
+import time
 
 import numpy as np
 import pytest
@@ -478,3 +479,53 @@ def test_tracing_off_leaves_query_untouched(proxy):
     assert q.result.status_code == ErrorCode.SUCCESS
     assert getattr(q, "trace", None) is None
     assert get_recorder().last() == []
+
+
+# ---------------------------------------------------------------------------
+# ROADMAP follow-up (e): HTTP scrape endpoint + periodic snapshot-to-file
+# ---------------------------------------------------------------------------
+
+def test_metrics_http_endpoint(monkeypatch):
+    """GET /metrics serves the Prometheus exposition, /metrics.json the
+    snapshot; metrics_port=0 (the default) starts nothing."""
+    import json as _json
+    import socket
+    import urllib.request
+
+    from wukong_tpu.obs import maybe_start_metrics_http, stop_metrics_http
+
+    assert maybe_start_metrics_http(port=0) is None  # default: off
+    with socket.socket() as s:  # find a free port
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    srv = maybe_start_metrics_http(port=port)
+    assert srv is not None
+    try:
+        get_registry().counter("wukong_obs_http_probe_total", "probe").inc()
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5).read().decode()
+        assert "# TYPE wukong_obs_http_probe_total counter" in body
+        js = _json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics.json", timeout=5).read())
+        assert js["wukong_obs_http_probe_total"]["kind"] == "counter"
+        # idempotent: a second start reuses the running server
+        assert maybe_start_metrics_http(port=port) is srv
+    finally:
+        stop_metrics_http()
+
+
+def test_metrics_snapshotter_writes_file(tmp_path):
+    import json as _json
+
+    from wukong_tpu.obs import MetricsSnapshotter
+
+    path = tmp_path / "soak_metrics.json"
+    snap = MetricsSnapshotter(str(path), interval_s=0.1)
+    get_registry().counter("wukong_obs_snap_probe_total", "probe").inc(3)
+    snap.start()
+    deadline = time.time() + 5
+    while not path.exists() and time.time() < deadline:
+        time.sleep(0.05)
+    snap.stop()
+    data = _json.loads(path.read_text())
+    assert data["wukong_obs_snap_probe_total"]["series"][0]["value"] == 3.0

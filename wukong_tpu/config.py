@@ -99,6 +99,35 @@ class GlobalConfig:
     # directory for JSON trace dumps ("" = in-memory only; the
     # WUKONG_TRACE_DIR env var is the out-of-band override)
     trace_dump_dir: str = ""
+    # HTTP scrape endpoint for render_prometheus() (GET /metrics; JSON
+    # snapshot at /metrics.json). 0 = off (default). The server runs on a
+    # stdlib http.server daemon thread, started lazily by the proxy /
+    # emulator via obs.httpd.maybe_start_metrics_http(). Binds loopback
+    # only unless metrics_host widens it (the endpoint has no auth).
+    metrics_port: int = 0
+    metrics_host: str = "127.0.0.1"
+    # periodic metrics snapshot-to-file for long soaks: every N seconds the
+    # registry's JSON snapshot is written to metrics_snapshot_path.
+    # 0 disables (default).
+    metrics_snapshot_s: int = 0
+    metrics_snapshot_path: str = ""
+
+    # ---- serving-path batching knobs (runtime/batcher.py; all mutable) ----
+    # coalesce live same-template queries into fused dispatches. OFF by
+    # default: the serving path is byte-for-byte unchanged unless enabled.
+    enable_batching: bool = False
+    # how long the first query of a group waits for company before the
+    # group flushes anyway (the Orca-style iteration window)
+    batch_window_us: int = 2000
+    # a group reaching this many members flushes immediately
+    batch_max_size: int = 64
+    # a query whose deadline has less than deadline_bypass_factor x
+    # batch_window_us remaining skips the batcher entirely
+    batch_deadline_bypass_factor: int = 4
+    # bounded-LRU sizes for the proxy's parse cache (query text -> parsed
+    # query) and plan cache (template signature + store version -> plan)
+    parse_cache_size: int = 512
+    plan_cache_size: int = 512
 
     # ---- TPU-engine knobs (new; no reference analogue) ----
     table_capacity_min: int = 1024  # smallest binding-table capacity class

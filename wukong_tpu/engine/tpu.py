@@ -64,8 +64,12 @@ class TPUEngine:
         self.cpu = CPUEngine(gstore, str_server)
         self.cap_min = Global.table_capacity_min
         self.cap_max = Global.table_capacity_max
+        from wukong_tpu.utils.lru import LRUCache
+
         self._est_planner = None  # lazy Planner over self.stats
-        self._est_cache: dict = {}  # pattern-tuple -> {step: rows}
+        # pattern-tuple -> {step: rows}; bounded LRU (a hot mixed workload
+        # used to lose EVERY estimate at the old clear-at-4096 threshold)
+        self._est_cache = LRUCache(4096)
         self._last_attempts = 0  # chain attempts of the last query (trace)
         from wukong_tpu.engine.tpu_merge import MergeExecutor
 
@@ -99,9 +103,7 @@ class TPUEngine:
             ests = None
         out = ({} if ests is None
                else {k: max(float(e), 1.0) for k, e in enumerate(ests)})
-        if len(self._est_cache) > 4096:
-            self._est_cache.clear()
-        self._est_cache[key] = out
+        self._est_cache.put(key, out)
         return out
 
     # ------------------------------------------------------------------

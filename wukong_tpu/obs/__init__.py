@@ -24,6 +24,12 @@ from wukong_tpu.obs.export import (
     maybe_device_trace,
     write_chrome_trace,
 )
+from wukong_tpu.obs.httpd import (
+    MetricsSnapshotter,
+    maybe_start_metrics_http,
+    maybe_start_snapshotter,
+    stop_metrics_http,
+)
 from wukong_tpu.obs.metrics import MetricsRegistry, get_registry
 from wukong_tpu.obs.recorder import DUMP_CODES, FlightRecorder, get_recorder
 from wukong_tpu.obs.trace import (
@@ -37,8 +43,10 @@ from wukong_tpu.obs.trace import (
 )
 
 __all__ = [
-    "DUMP_CODES", "FlightRecorder", "MetricsRegistry", "QueryTrace", "Span",
-    "StepTrace", "activate", "chrome_trace_events", "current", "device_trace",
-    "get_recorder", "get_registry", "maybe_device_trace", "maybe_start_trace",
-    "trace_event", "write_chrome_trace",
+    "DUMP_CODES", "FlightRecorder", "MetricsRegistry", "MetricsSnapshotter",
+    "QueryTrace", "Span", "StepTrace", "activate", "chrome_trace_events",
+    "current", "device_trace", "get_recorder", "get_registry",
+    "maybe_device_trace", "maybe_start_metrics_http", "maybe_start_snapshotter",
+    "maybe_start_trace", "stop_metrics_http", "trace_event",
+    "write_chrome_trace",
 ]

@@ -1,0 +1,142 @@
+"""HTTP scrape endpoint + periodic snapshot-to-file for the metrics registry.
+
+ROADMAP follow-up (e) to the observability layer: render_prometheus() was
+scrape-*able* but nothing fronted it. This module adds:
+
+- :func:`maybe_start_metrics_http` — a stdlib ``http.server`` daemon thread
+  serving ``GET /metrics`` (Prometheus text exposition) and
+  ``GET /metrics.json`` (the JSON snapshot), gated on the ``metrics_port``
+  config knob (0 = off, the default). Idempotent per process.
+- :class:`MetricsSnapshotter` — a daemon thread that writes the registry's
+  JSON snapshot to a file every ``interval_s`` seconds (atomic
+  tmp-then-rename), for the emulator's long soaks where scraping is
+  impractical. Gated on ``metrics_snapshot_s`` / ``metrics_snapshot_path``.
+
+Everything here is pull-side only: the hot path never knows the server
+exists (gauge callbacks are evaluated at scrape time by the registry).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from wukong_tpu.config import Global
+from wukong_tpu.obs.metrics import get_registry
+from wukong_tpu.utils.logger import log_info, log_warn
+
+_lock = threading.Lock()
+_server: "ThreadingHTTPServer | None" = None
+
+
+class _MetricsHandler(BaseHTTPRequestHandler):
+    def do_GET(self):  # noqa: N802 (stdlib handler naming)
+        path = self.path.split("?", 1)[0]
+        if path in ("/metrics", "/"):
+            body = get_registry().render_prometheus().encode()
+            ctype = "text/plain; version=0.0.4; charset=utf-8"
+        elif path == "/metrics.json":
+            body = json.dumps(get_registry().snapshot(), indent=1).encode()
+            ctype = "application/json"
+        elif path == "/healthz":
+            body, ctype = b"ok\n", "text/plain"
+        else:
+            self.send_error(404)
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, fmt, *args):  # scrapes stay out of stdout
+        pass
+
+
+def maybe_start_metrics_http(port: int | None = None):
+    """Start the scrape endpoint if configured; returns the server or None.
+
+    ``port`` overrides ``Global.metrics_port``; 0/None means off. Starting
+    is idempotent — a second call (or a second Proxy in-process) reuses the
+    already-running server.
+    """
+    global _server
+    p = Global.metrics_port if port is None else port
+    if not p or p <= 0:
+        return None
+    with _lock:
+        if _server is not None:
+            return _server
+        host = Global.metrics_host or "127.0.0.1"
+        try:
+            srv = ThreadingHTTPServer((host, int(p)), _MetricsHandler)
+        except OSError as e:
+            log_warn(f"metrics http endpoint failed to bind :{p}: {e}")
+            return None
+        srv.daemon_threads = True
+        t = threading.Thread(target=srv.serve_forever, daemon=True,
+                             name="metrics-http")
+        t.start()
+        _server = srv
+        log_info(f"metrics http endpoint on :{srv.server_address[1]} "
+                 "(/metrics, /metrics.json)")
+        return srv
+
+
+def stop_metrics_http() -> None:
+    """Shut the endpoint down (tests / console teardown)."""
+    global _server
+    with _lock:
+        if _server is not None:
+            _server.shutdown()
+            _server.server_close()
+            _server = None
+
+
+class MetricsSnapshotter:
+    """Periodic registry-snapshot-to-file writer for long soaks."""
+
+    def __init__(self, path: str, interval_s: float):
+        self.path = path
+        self.interval_s = max(float(interval_s), 0.1)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.writes = 0
+
+    def start(self) -> "MetricsSnapshotter":
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="metrics-snapshot")
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.write_once()
+
+    def write_once(self) -> None:
+        try:
+            tmp = f"{self.path}.tmp"
+            with open(tmp, "w") as f:
+                json.dump(get_registry().snapshot(), f, indent=1)
+            os.replace(tmp, self.path)  # atomic: a soak reader never sees
+            self.writes += 1            # a torn snapshot
+        except OSError as e:
+            log_warn(f"metrics snapshot write failed: {e}")
+
+    def stop(self, final_write: bool = True) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+        if final_write:
+            self.write_once()
+
+
+def maybe_start_snapshotter() -> "MetricsSnapshotter | None":
+    """A snapshotter per the ``metrics_snapshot_s`` / ``metrics_snapshot_path``
+    knobs, or None when off (the default)."""
+    if Global.metrics_snapshot_s <= 0 or not Global.metrics_snapshot_path:
+        return None
+    return MetricsSnapshotter(Global.metrics_snapshot_path,
+                              Global.metrics_snapshot_s).start()

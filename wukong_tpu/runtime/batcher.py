@@ -1,0 +1,704 @@
+"""Continuous micro-batching for the serving path (Orca-style coalescing).
+
+The engine layer already amortizes compilation and device syncs across query
+batches (``TPUEngine.execute_batch`` / ``MergeExecutor.run_batch_const_many``
+— how the emulator reaches its headline throughput), but the *serving* path
+(proxy -> engine) executed one query per dispatch, so live traffic never saw
+that win. This module closes the gap:
+
+- :func:`template_signature` / :class:`PlanCache` — the proxy-level plan
+  cache: repeated template *shapes* (pattern structure with normal-id
+  constants abstracted) reuse the optimizer's plan as a positional recipe,
+  keyed on signature + store version (dynamic inserts / stream commits bump
+  the version, so stale plans can never be applied).
+- :func:`batchable` / :func:`fused_key` — the compatibility test and group
+  key: queries whose planned chains differ ONLY in the start constant (the
+  same shape discipline ``TPUEngine._check_batch_const`` enforces) may fuse.
+- :class:`QueryBatcher` — the adaptive coalescer between the proxy and the
+  engines: compatible queries arriving within ``batch_window_us`` (or until
+  ``batch_max_size``) fuse into ONE chain dispatch over a qid-stamped
+  binding table; results are scattered back to each caller's future.
+  Incompatible or deadline-tight queries bypass untouched, and with
+  ``enable_batching`` off (the default) the serving path never reaches this
+  module at all.
+- :class:`FusedGroup` — the dispatch unit: builds the fused query (start
+  constant rewritten to a seeded known var next to a qid column), runs it on
+  the CPU or TPU engine (both handle seeded chains), splits the result table
+  by qid, applies per-member deadline/budget accounting (one member's
+  timeout degrades only that member), and falls back to per-query execution
+  when the fused dispatch fails or the batch breaker is open.
+
+Row-order fidelity: the CPU/TPU kernels expand row-major and filter
+in-place, so a member's rows in the fused table appear contiguously and in
+exactly the order its own sequential execution would produce — batched
+results are byte-identical to unbatched ones (tests/test_batcher.py pins
+this against the independent BGP oracle).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from wukong_tpu.config import Global
+from wukong_tpu.obs import activate, get_recorder, get_registry, maybe_start_trace
+from wukong_tpu.runtime.resilience import CircuitBreaker, mark_partial
+from wukong_tpu.sparql.ir import Pattern, SPARQLQuery
+from wukong_tpu.types import NORMAL_ID_START, PREDICATE_ID, TYPE_ID, AttrType
+from wukong_tpu.utils.errors import BudgetExceeded, ErrorCode, QueryTimeout
+from wukong_tpu.utils.logger import log_warn
+from wukong_tpu.utils.lru import LRUCache
+from wukong_tpu.utils.timer import get_usec
+
+_SID = int(AttrType.SID_t)
+
+# batcher observability (README metrics table): occupancy + flush reasons
+# are the knobs' feedback loop — a window that always flushes at size 1
+# is pure added latency, one that always hits batch_max_size could go wider
+_M_SUBMITTED = get_registry().counter(
+    "wukong_batch_submitted_total", "Queries admitted into the batcher")
+_M_BYPASS = get_registry().counter(
+    "wukong_batch_bypass_total",
+    "Queries that skipped the batcher", labels=("reason",))
+_M_FLUSH = get_registry().counter(
+    "wukong_batch_flush_total", "Group flushes", labels=("reason",))
+_M_FUSED = get_registry().counter(
+    "wukong_batch_fused_queries_total", "Queries served by a fused dispatch")
+_M_FALLBACK = get_registry().counter(
+    "wukong_batch_fallback_total",
+    "Fused dispatches degraded to per-query execution", labels=("reason",))
+_M_MEMBER_TIMEOUT = get_registry().counter(
+    "wukong_batch_member_timeouts_total",
+    "Members individually degraded by their own deadline/budget")
+_M_OCCUPANCY = get_registry().histogram(
+    "wukong_batch_occupancy", "Group size at flush",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024))
+_M_PLAN_CACHE = get_registry().counter(
+    "wukong_plan_cache_total", "Plan cache lookups", labels=("outcome",))
+_M_PARSE_CACHE = get_registry().counter(
+    "wukong_parse_cache_total", "Parse cache lookups", labels=("outcome",))
+
+
+# ---------------------------------------------------------------------------
+# template signatures + the plan cache
+# ---------------------------------------------------------------------------
+
+def template_signature(q: SPARQLQuery):
+    """Pre-plan template signature: the pattern structure with normal-id
+    constants abstracted out. Two queries with the same signature may share
+    one plan (any valid join order yields the same result set). Returns
+    None for shapes the plan cache does not cover (unions/optionals plan
+    recursively; attr patterns ride along fine)."""
+    pg = q.pattern_group
+    if pg.unions or pg.optional or not pg.patterns:
+        return None
+
+    def elem(v: int):
+        if v < 0:
+            return ("v", v)
+        if v >= NORMAL_ID_START:
+            return "C"  # abstracted: the template's variable constant
+        return ("k", v)  # type ids / specials: structural, kept concrete
+
+    return tuple(
+        (elem(p.subject),
+         p.predicate if p.predicate >= 0 else ("v", p.predicate),
+         int(p.direction), elem(p.object), int(p.pred_type))
+        for p in pg.patterns)
+
+
+def build_plan_recipe(parsed_patterns: list, q: SPARQLQuery):
+    """Encode a planned query as a positional recipe over its parsed
+    (pre-plan) patterns, so the plan can be replayed onto any same-signature
+    query with different constants. Returns None when the plan is not
+    safely replayable (planner-proved-empty plans depend on the concrete
+    constants; duplicated abstracted constants are positionally ambiguous).
+    """
+    if q.planner_empty or q.corun_enabled:
+        return None
+    # parsed value -> positions; field index 0/1/2 = subject/predicate/object
+    slots: dict[int, list] = {}
+    for i, (s, p, _d, o, _t) in enumerate(parsed_patterns):
+        for fi, v in ((0, s), (1, p), (2, o)):
+            if v >= 0:
+                slots.setdefault(v, []).append((i, fi))
+
+    def enc(v: int):
+        if v < 0:
+            return ("v", v)
+        sl = slots.get(v)
+        if sl is None:
+            # plan-introduced structural ids only (index-start rewrites)
+            return ("lit", v) if v in (PREDICATE_ID, TYPE_ID) else None
+        # positions that are concrete in the signature (predicates, type
+        # ids) pin the value — no substitution needed
+        if any(fi == 1 or v < NORMAL_ID_START for (_i, fi) in sl):
+            return ("lit", v)
+        if len(sl) > 1:
+            return None  # ambiguous duplicate of an abstracted constant
+        return ("slot", sl[0])
+
+    recipe = []
+    for pat in q.pattern_group.patterns:
+        es, ep, eo = enc(pat.subject), enc(pat.predicate), enc(pat.object)
+        if es is None or ep is None or eo is None:
+            return None
+        recipe.append((es, ep, int(pat.direction), eo, int(pat.pred_type)))
+    return tuple(recipe)
+
+
+def apply_plan_recipe(q: SPARQLQuery, recipe) -> bool:
+    """Replay a cached plan recipe onto a freshly parsed same-signature
+    query. Builds the new pattern list fully before swapping it in."""
+    pats = q.pattern_group.patterns
+
+    def dec(e):
+        kind, val = e
+        if kind in ("v", "lit"):
+            return val
+        i, fi = val
+        p = pats[i]
+        return (p.subject, p.predicate, p.object)[fi]
+
+    try:
+        new = [Pattern(dec(es), dec(ep), d, dec(eo), pt)
+               for (es, ep, d, eo, pt) in recipe]
+    except (IndexError, TypeError):  # stale/foreign recipe: replan
+        return False
+    q.pattern_group.patterns[:] = new
+    return True
+
+
+class PlanCache:
+    """Template signature + store version -> plan recipe (bounded LRU).
+
+    Keying on the store version makes dynamic inserts / stream commits
+    self-invalidating: the bumped version simply never matches a stale
+    entry, and the LRU evicts the dead keys."""
+
+    def __init__(self, maxsize: int | None = None):
+        self._lru = LRUCache(maxsize or Global.plan_cache_size)
+
+    def lookup(self, q: SPARQLQuery, sig, version: int) -> bool:
+        if sig is None:
+            return False
+        recipe = self._lru.get((sig, version))
+        if recipe is None:
+            _M_PLAN_CACHE.labels(outcome="miss").inc()
+            return False
+        if not apply_plan_recipe(q, recipe):
+            _M_PLAN_CACHE.labels(outcome="miss").inc()
+            return False
+        _M_PLAN_CACHE.labels(outcome="hit").inc()
+        return True
+
+    def record(self, parsed_patterns, q: SPARQLQuery, sig, version: int) -> None:
+        if sig is None:
+            return
+        recipe = build_plan_recipe(parsed_patterns, q)
+        if recipe is not None:
+            self._lru.put((sig, version), recipe)
+
+    def clear(self) -> None:
+        self._lru.clear()
+
+    def stats(self) -> dict:
+        return self._lru.stats()
+
+
+def snapshot_patterns(q: SPARQLQuery) -> list:
+    """Pre-plan pattern snapshot for build_plan_recipe (plan mutates the
+    list in place)."""
+    return [(p.subject, p.predicate, p.direction, p.object, p.pred_type)
+            for p in q.pattern_group.patterns]
+
+
+# ---------------------------------------------------------------------------
+# batchability + group key
+# ---------------------------------------------------------------------------
+
+def batchable(q: SPARQLQuery) -> bool:
+    """True when a PLANNED query may join a fused group: a const-start
+    chain of const-SID-predicate steps, each anchored on a bound column —
+    the ``_check_batch_const`` shape — with no result-shaping modifiers
+    (those apply per member and would be wrong on the fused table)."""
+    pg = q.pattern_group
+    if pg.unions or pg.optional:
+        return False
+    if q.distinct or q.orders or q.limit >= 0 or q.offset > 0:
+        return False
+    if q.mt_factor > 1 or q.planner_empty or q.corun_enabled:
+        return False
+    pats = pg.patterns
+    if not pats:
+        return False
+    c0 = pats[0].subject
+    if c0 < NORMAL_ID_START:  # needs a plain const start (not index/type)
+        return False
+    if pats[0].object >= 0:  # first step must bind a fresh var
+        return False
+    known = {c0}
+    for k, p in enumerate(pats):
+        if p.predicate < 0 or p.pred_type != _SID:
+            return False
+        if k == 0:
+            if p.subject != c0:
+                return False
+        elif p.subject == c0:
+            # mid-chain re-anchor on the start constant: sequential
+            # execution runs const_to_known, which needs a bound object
+            if not (p.object < 0 and p.object in known):
+                return False
+        elif not (p.subject < 0 and p.subject in known):
+            return False
+        for v in (p.subject, p.object):
+            if v < 0:
+                known.add(v)
+    return True
+
+
+def fused_key(q: SPARQLQuery):
+    """Group key for a planned batchable query: every occurrence of the
+    start constant abstracted, everything else (predicates, other
+    constants, filters, projection, blind mode) concrete — members of one
+    group differ ONLY in where they start."""
+    pats = q.pattern_group.patterns
+    c0 = pats[0].subject
+
+    def el(v: int):
+        return "<start>" if v == c0 else v
+
+    return (tuple((el(p.subject), p.predicate, int(p.direction),
+                   el(p.object), int(p.pred_type)) for p in pats),
+            repr(q.pattern_group.filters),
+            tuple(q.result.required_vars),
+            bool(q.result.blind))
+
+
+# ---------------------------------------------------------------------------
+# the fused dispatch unit
+# ---------------------------------------------------------------------------
+
+class _Pending:
+    """One caller's slot in a group: the planned query, its resilience
+    context, and the future the serving thread blocks on."""
+
+    __slots__ = ("q", "deadline", "trace", "event", "error", "t0_us")
+
+    def __init__(self, q: SPARQLQuery):
+        self.q = q
+        self.deadline = getattr(q, "deadline", None)
+        self.trace = getattr(q, "trace", None)
+        self.event = threading.Event()
+        self.error: BaseException | None = None
+        self.t0_us = get_usec()
+
+    def wait(self, timeout: float | None = None) -> SPARQLQuery:
+        if not self.event.wait(timeout):
+            raise TimeoutError("batched query still pending")
+        if self.error is not None:
+            raise self.error
+        return self.q
+
+
+def _fused_deadline(members: list):
+    """The fused chain's Deadline: the LOOSEST member wall-clock (a tight
+    member is settled per-member after the dispatch, never failing the
+    group) and the SUM of member row budgets — present only when every
+    member carries the respective constraint."""
+    from wukong_tpu.runtime.resilience import Deadline
+
+    rems, budgets, no_wall = [], [], False
+    for m in members:
+        if m.deadline is None:
+            return None  # an unconstrained member: the group is too
+        rem = m.deadline.remaining_s()
+        if rem is None:
+            no_wall = True  # that member has a budget but no wall clock
+        else:
+            rems.append(rem)
+        budgets.append(m.deadline.budget_rows)
+    timeout_ms = 0 if (no_wall or not rems) else int(max(rems) * 1e3) + 1
+    budget = sum(budgets) if budgets and all(b > 0 for b in budgets) else 0
+    if timeout_ms <= 0 and budget <= 0:
+        return None
+    return Deadline(timeout_ms, budget)
+
+
+class FusedGroup:
+    """A flushed group of same-template queries, executed as one unit.
+
+    The engine pool's ``batch`` lane pops a group whole (work stealing
+    cannot split it) and calls :meth:`run` with the popping engine; an
+    inline dispatch (no pool) passes the batcher's own engine."""
+
+    is_fused_group = True
+
+    def __init__(self, members: list, batcher: "QueryBatcher",
+                 engine=None, reason: str = "window"):
+        self.members = members
+        self.batcher = batcher
+        self.engine = engine  # preferred engine (the TPU path), or None
+        self.reason = reason
+        self._noted = False  # in-flight accounting settled exactly once
+
+    # -- completion plumbing -------------------------------------------
+    @staticmethod
+    def _finish(m: _Pending) -> None:
+        m.event.set()
+
+    def _note_once(self) -> None:
+        if not self._noted:
+            self._noted = True
+            self.batcher._note_done()
+
+    def fail_all(self, exc: BaseException) -> None:
+        """Infrastructure failure (dead pool / engine-thread death): the
+        waiters must never strand."""
+        for m in self.members:
+            if not m.event.is_set():
+                m.error = exc
+                m.event.set()
+        self._note_once()
+
+    # -- execution ------------------------------------------------------
+    def run(self, engine=None) -> None:
+        try:
+            self._run_impl(engine)
+        except BaseException as e:  # the waiters must never strand
+            self.fail_all(e)
+            raise
+        finally:
+            self._note_once()
+
+    def _run_impl(self, engine) -> None:
+        b = self.batcher
+        live = []
+        for m in self.members:
+            if m.deadline is not None and m.deadline.expired():
+                # shed in the batch queue: mirror the pool's load shedding
+                # (structured timeout, group unaffected)
+                _M_MEMBER_TIMEOUT.inc()
+                mark_partial(m.q, QueryTimeout("deadline expired in batch window"))
+                self._finish(m)
+            else:
+                live.append(m)
+        if not live:
+            return
+        if len(live) == 1:
+            self._run_single(live[0], engine)
+            return
+        if not b.breaker.allow("batch.dispatch"):
+            # breaker open: don't pay the fused failure again — serve the
+            # members per-query until the half-open probe closes it
+            _M_FALLBACK.labels(reason="breaker_open").inc()
+            for m in live:
+                self._run_single(m, engine)
+            return
+        fq = None
+        try:
+            fq = self._run_fused(live, engine)
+        except Exception as e:
+            b.breaker.record_failure("batch.dispatch")
+            _M_FALLBACK.labels(reason="dispatch_error").inc()
+            log_warn(f"fused batch dispatch failed ({e!r:.120}); "
+                     f"degrading {len(live)} queries to per-query execution")
+            for m in live:
+                self._run_single(m, engine)
+            return
+        if fq.result.status_code != ErrorCode.SUCCESS:
+            # QueryTimeout/BudgetExceeded/ShardUnavailable surface as the
+            # fused reply status — same degradation: per-query execution
+            # settles each member against its own deadline/breakers
+            b.breaker.record_failure("batch.dispatch")
+            _M_FALLBACK.labels(
+                reason=fq.result.status_code.name.lower()).inc()
+            for m in live:
+                self._run_single(m, engine)
+            return
+        b.breaker.record_success("batch.dispatch")
+        self._scatter(fq, live)
+
+    def _run_single(self, m: _Pending, engine) -> None:
+        """Per-query degradation path (and the natural size-1 flush)."""
+        eng = self.engine or engine or self.batcher.cpu
+        try:
+            eng.execute(m.q, from_proxy=True)
+        except Exception as e:  # engine contract: errors become the reply;
+            m.error = e        # anything else is infrastructure
+        self._finish(m)
+
+    def _run_fused(self, live: list, engine):
+        """Build + dispatch the fused query: [qid, start-const] seed table,
+        start constant rewritten to a seeded known var, one chain run."""
+        eng = self.engine or engine or self.batcher.cpu
+        q0 = live[0].q
+        pats0 = q0.pattern_group.patterns
+        c0 = pats0[0].subject
+        consts = np.asarray(
+            [m.q.pattern_group.patterns[0].subject for m in live],
+            dtype=np.int64)
+        B = len(live)
+
+        low = min((v for p in pats0 for v in (p.subject, p.predicate, p.object)
+                   if v < 0), default=0)
+        vq, vs = low - 1, low - 2
+        fq = SPARQLQuery()
+        fq.pattern_group.patterns = [
+            Pattern(vs if p.subject == c0 else p.subject, p.predicate,
+                    p.direction, vs if p.object == c0 else p.object,
+                    p.pred_type)
+            for p in pats0]
+        fq.pattern_group.filters = q0.pattern_group.filters
+        res = fq.result
+        res.nvars = q0.result.nvars + 2
+        res.set_table(np.column_stack(
+            [np.arange(B, dtype=np.int64), consts]))
+        res.add_var2col(vq, 0)
+        res.add_var2col(vs, 1)
+        res.blind = False  # the fused table IS the members' results
+        fq.deadline = _fused_deadline(live)
+
+        # batch.dispatch span: its own sampled trace for the flight
+        # recorder, plus a linking event on every member trace
+        ftrace = maybe_start_trace(kind="batch")
+        gid = ftrace.trace_id if ftrace is not None else None
+        member_tids = [m.trace.trace_id for m in live if m.trace is not None]
+        for m in live:
+            if m.trace is not None:
+                m.trace.event("batch.dispatch", group=gid, size=B,
+                              reason=self.reason)
+        if ftrace is None:
+            eng.execute(fq, from_proxy=False)
+        else:
+            fq.trace = ftrace
+            with activate(ftrace):
+                with ftrace.span("batch.dispatch", size=B,
+                                 reason=self.reason, members=member_tids):
+                    eng.execute(fq, from_proxy=False)
+            get_recorder().on_complete(ftrace, fq.result.status_code)
+        return fq
+
+    def _scatter(self, fq: SPARQLQuery, live: list) -> None:
+        """Split the fused table by qid and settle each member against its
+        own deadline/budget — one member's expiry degrades only itself."""
+        tbl = np.asarray(fq.result.table)
+        C = fq.result.col_num
+        member_v2c = {v: c - 2 for v, c in fq.result.v2c_map.items()
+                      if c >= 2}
+        qids = tbl[:, 0] if len(tbl) else np.empty(0, dtype=np.int64)
+        _M_FUSED.inc(len(live))
+        for i, m in enumerate(live):
+            rows = (tbl[qids == i][:, 2:] if len(tbl)
+                    else np.empty((0, max(C - 2, 0)), dtype=np.int64))
+            res = m.q.result
+            res.v2c_map = dict(member_v2c)
+            res.set_table(np.ascontiguousarray(rows).astype(np.int64))
+            res.col_num = max(C - 2, 0)
+            m.q.pattern_step = len(m.q.pattern_group.patterns)
+            try:
+                if m.deadline is not None:
+                    m.deadline.charge_rows(res.nrows, "batch.dispatch")
+                    m.deadline.check("batch.dispatch")
+                self.batcher.cpu._final_process(m.q)
+            except (QueryTimeout, BudgetExceeded) as e:
+                _M_MEMBER_TIMEOUT.inc()
+                mark_partial(m.q, e)
+            except Exception as e:
+                m.error = e
+            self._finish(m)
+
+
+# ---------------------------------------------------------------------------
+# the batcher
+# ---------------------------------------------------------------------------
+
+class _OpenGroup:
+    __slots__ = ("members", "flush_at_us")
+
+    def __init__(self, flush_at_us: int):
+        self.members: list[_Pending] = []
+        self.flush_at_us = flush_at_us
+
+
+class QueryBatcher:
+    """Adaptive request coalescer between the proxy and the engines.
+
+    ``offer(q)`` admits a planned query and returns its :class:`_Pending`
+    future, or None when the query must bypass (incompatible shape /
+    deadline too tight) — the caller then executes it directly. A
+    background flusher dispatches groups at ``batch_window_us`` age;
+    ``batch_max_size`` flushes immediately. Groups ride the engine pool's
+    ``batch`` lane when a pool is running (drained as a unit), else they
+    run inline on the flusher thread.
+    """
+
+    def __init__(self, cpu_engine, tpu_engine=None, pool=None):
+        self.cpu = cpu_engine
+        self.tpu = tpu_engine
+        self._pool = pool  # object, or zero-arg callable returning one/None
+        self.breaker = CircuitBreaker()
+        self._lock = threading.Condition()
+        self._groups: dict = {}
+        # dispatches currently executing: the continuous-batching signal —
+        # while one runs, arrivals accumulate; when idle, a lone query
+        # flushes immediately instead of paying the window
+        self._inflight = 0
+        self._drain_now = False
+        self._stopped = False
+        self._thread = threading.Thread(target=self._flusher, daemon=True,
+                                        name="batcher-flush")
+        self._thread.start()
+
+    # ------------------------------------------------------------------
+    def offer(self, q: SPARQLQuery) -> _Pending | None:
+        """Admit a planned query; None means bypass (caller dispatches)."""
+        if self.cpu is None or self._stopped:
+            return None
+        dl = getattr(q, "deadline", None)
+        if dl is not None:
+            if dl.budget_rows > 0:
+                # per-STEP intermediate-row budgets cannot be attributed to
+                # members inside a fused chain (a member's blowup would be
+                # subsidized by the group's summed budget) — budgeted
+                # queries keep exact sequential enforcement
+                _M_BYPASS.labels(reason="budget").inc()
+                return None
+            rem = dl.remaining_s()
+            if rem is not None and rem < (
+                    Global.batch_deadline_bypass_factor
+                    * Global.batch_window_us / 1e6):
+                _M_BYPASS.labels(reason="deadline").inc()
+                return None
+        if not batchable(q):
+            _M_BYPASS.labels(reason="shape").inc()
+            return None
+        p = _Pending(q)
+        key = fused_key(q)
+        to_flush = None
+        reason = "size"
+        with self._lock:
+            grp = self._groups.get(key)
+            if grp is None:
+                grp = self._groups[key] = _OpenGroup(
+                    get_usec() + max(int(Global.batch_window_us), 0))
+            grp.members.append(p)
+            if len(grp.members) >= max(int(Global.batch_max_size), 1):
+                to_flush = self._groups.pop(key)
+            elif self._inflight == 0 and len(grp.members) == 1 \
+                    and len(self._groups) == 1:
+                # iteration-level batching: nothing is executing and nothing
+                # else is queued — waiting out the window would only add
+                # latency. Dispatch now; queries arriving DURING this
+                # dispatch accumulate into the next group (that overlap is
+                # where the coalescing comes from under load).
+                to_flush = self._groups.pop(key)
+                reason = "idle"
+            else:
+                self._lock.notify()
+        _M_SUBMITTED.inc()
+        if to_flush is not None:
+            self._dispatch(to_flush.members, reason=reason)
+        return p
+
+    # ------------------------------------------------------------------
+    def _flusher(self) -> None:
+        while True:
+            try:
+                if self._flusher_tick():
+                    return
+            except Exception as e:  # the flusher must never die: waiters
+                log_warn(f"batch flusher error: {e!r}")  # depend on it
+
+    def _flusher_tick(self) -> bool:
+        """One flusher iteration; True = stop."""
+        while True:
+            due = []
+            reason = "window"
+            with self._lock:
+                if self._stopped:
+                    return True
+                now = get_usec()
+                next_due = None
+                if self._drain_now and self._inflight == 0:
+                    # iteration boundary: take everything that queued
+                    # behind the dispatch that just finished
+                    due = [self._groups.pop(k) for k in list(self._groups)]
+                    reason = "idle"
+                else:
+                    for key in list(self._groups):
+                        grp = self._groups[key]
+                        if grp.flush_at_us <= now:
+                            due.append(self._groups.pop(key))
+                        elif next_due is None or grp.flush_at_us < next_due:
+                            next_due = grp.flush_at_us
+                self._drain_now = False
+                if not due:
+                    self._lock.wait(
+                        None if next_due is None
+                        else max(next_due - now, 50) / 1e6)
+                    continue
+            for grp in due:
+                try:
+                    self._dispatch(grp.members, reason=reason)
+                except Exception as e:  # settle, never strand a waiter
+                    for m in grp.members:
+                        if not m.event.is_set():
+                            m.error = e
+                            m.event.set()
+
+    def _note_done(self) -> None:
+        """A dispatch finished. If it was the last one in flight, wake the
+        flusher to release the groups that accumulated while it ran — the
+        next iteration starts NOW with whatever queued (Orca-style
+        iteration-level scheduling); the window is only the upper bound on
+        wait. The flusher (not this stack) dispatches, so back-to-back
+        iterations never recurse."""
+        with self._lock:
+            self._inflight = max(self._inflight - 1, 0)
+            if self._inflight == 0 and self._groups:
+                self._drain_now = True
+                self._lock.notify()
+
+    def _dispatch(self, members: list, reason: str) -> None:
+        _M_FLUSH.labels(reason=reason).inc()
+        _M_OCCUPANCY.observe(len(members))
+        with self._lock:
+            self._inflight += 1
+        engine = (self.tpu if (Global.enable_tpu and self.tpu is not None)
+                  else None)
+        group = FusedGroup(members, self, engine=engine, reason=reason)
+        pool = self._pool() if callable(self._pool) else self._pool
+        if pool is not None:
+            try:
+                pool.submit(group, lane="batch")
+                return
+            except Exception as e:
+                log_warn(f"batch lane submit failed ({e!r}); running inline")
+        try:
+            group.run(None)
+        except Exception:
+            pass  # members are settled (fail_all) inside run()
+
+    # ------------------------------------------------------------------
+    def flush(self) -> None:
+        """Flush every open group now (drain; tests and shutdown)."""
+        with self._lock:
+            due = list(self._groups.values())
+            self._groups.clear()
+        for grp in due:
+            self._dispatch(grp.members, reason="drain")
+
+    def close(self) -> None:
+        with self._lock:
+            self._stopped = True
+            self._lock.notify_all()
+        self.flush()
+        self._thread.join(timeout=2)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"open_groups": len(self._groups),
+                    "queued": sum(len(g.members)
+                                  for g in self._groups.values())}

@@ -25,7 +25,13 @@ import time
 import numpy as np
 
 from wukong_tpu.config import Global
-from wukong_tpu.obs import get_recorder, maybe_start_trace, write_chrome_trace
+from wukong_tpu.obs import (
+    activate,
+    get_recorder,
+    maybe_start_snapshotter,
+    maybe_start_trace,
+    write_chrome_trace,
+)
 from wukong_tpu.planner.heuristic import heuristic_plan
 from wukong_tpu.runtime.monitor import Monitor
 from wukong_tpu.runtime.resilience import Deadline
@@ -277,6 +283,26 @@ class Emulator:
                 return
         heuristic_plan(q)
 
+    @staticmethod
+    def _traced_flight(fn, **attrs):
+        """One device-batch flight under a sampled ``batch.dispatch`` span
+        (ROADMAP follow-up f: W>1 flights used to trace only per-instance
+        pool queries). The untraced path is one config check + ``fn()``."""
+        ftr = maybe_start_trace(kind="device_batch")
+        if ftr is None:
+            return fn()
+        with activate(ftr):
+            sp = ftr.start_span("batch.dispatch", **attrs)
+            try:
+                out = fn()
+            except Exception:
+                ftr.end_span(sp, status="ERROR")
+                get_recorder().on_complete(ftr, "ERROR")
+                raise
+            ftr.end_span(sp)
+        get_recorder().on_complete(ftr, ErrorCode.SUCCESS)
+        return out
+
     def _device_batch(self, kind, tmpl, q0, rng, B: int, cls: int) -> bool:
         """Try the synchronous compiled-batch path; True when it ran."""
         if kind == "light" and self._batchable(tmpl, q0):
@@ -312,7 +338,9 @@ class Emulator:
                          self._draw_consts(self._planned[c][1], rng, B))
                         for c in draws]
                 try:
-                    tpu.execute_batch_mixed(jobs)
+                    self._traced_flight(
+                        lambda: tpu.execute_batch_mixed(jobs),
+                        mode="mixed", W=W, B=B, classes=sorted(set(draws)))
                 except (WukongError, RuntimeError):
                     # the failure could come from ANY drawn class's chain —
                     # de-warm them ALL (each re-warms through its own
@@ -338,7 +366,10 @@ class Emulator:
                     self.class_mode[c] = "device-batch"
                 return True
             try:
-                tpu.execute_batch(q0, self._draw_consts(tmpl, rng, B))
+                self._traced_flight(
+                    lambda: tpu.execute_batch(
+                        q0, self._draw_consts(tmpl, rng, B)),
+                    mode="const", W=1, B=B, classes=[cls])
                 q0._many_warm = True
                 served = B
                 if self._mixed_fail.get(cls, 0) >= self.MIXED_FAIL_LIMIT:
@@ -369,9 +400,14 @@ class Emulator:
             t0 = get_usec()
             try:
                 if W > 1:
-                    self.proxy.tpu.execute_batch_index_many(q0, bh, W)
+                    self._traced_flight(
+                        lambda: self.proxy.tpu.execute_batch_index_many(
+                            q0, bh, W),
+                        mode="index", W=W, B=bh, classes=[cls])
                 else:
-                    self.proxy.tpu.execute_batch_index(q0, bh)
+                    self._traced_flight(
+                        lambda: self.proxy.tpu.execute_batch_index(q0, bh),
+                        mode="index", W=1, B=bh, classes=[cls])
                     q0._many_warm = True
             except (WukongError, RuntimeError):
                 # RuntimeError: XLA OOM from the W-fold window footprint
@@ -382,6 +418,77 @@ class Emulator:
                                      count=bh * W)
             return True
         return False
+
+    # ------------------------------------------------------------------
+    def run_serving(self, texts: list, duration_s: float = 5.0,
+                    warmup_s: float = 0.5, clients: int = 4,
+                    seed: int = 0) -> dict:
+        """Serving-path throughput: ``clients`` closed-loop threads each
+        submit one query TEXT at a time through the proxy serving entry
+        (parse cache -> plan cache -> batcher-or-direct -> engine) and
+        wait for the reply — live traffic, not the compiled-batch emulator
+        path. Batching behavior follows ``Global.enable_batching``; the
+        before/after pair of this number is `bench.py --serve-batched`'s
+        headline. Starts the periodic metrics snapshotter when the
+        ``metrics_snapshot_s`` knob asks for one (long-soak observability).
+        """
+        import threading
+
+        # NOTE: the pool is NOT started here — fused groups ride the batch
+        # lane only when a pool is already running (stream/emulator mixes);
+        # otherwise they dispatch inline on the batcher's flusher thread.
+        # On small hosts the idle engines' busy-poll would steal the very
+        # cores the fused dispatch needs.
+        snap = maybe_start_snapshotter()
+        stop = threading.Event()
+        served = [0] * clients
+        errors = [0] * clients
+        lat: list[list] = [[] for _ in range(clients)]
+        t_measure = [0.0]
+
+        def client(k: int) -> None:
+            rng = np.random.default_rng(seed + k)
+            while not stop.is_set():
+                text = texts[int(rng.integers(0, len(texts)))]
+                t0 = get_usec()
+                try:
+                    q = self.proxy.serve_query(text, blind=True)
+                    if q.result.status_code != ErrorCode.SUCCESS:
+                        errors[k] += 1
+                        continue
+                except Exception:
+                    errors[k] += 1
+                    continue
+                if time.monotonic() >= t_measure[0]:
+                    served[k] += 1
+                    lat[k].append(get_usec() - t0)
+
+        threads = [threading.Thread(target=client, args=(k,), daemon=True,
+                                    name=f"serve-client-{k}")
+                   for k in range(clients)]
+        t_measure[0] = time.monotonic() + warmup_s
+        for t in threads:
+            t.start()
+        time.sleep(warmup_s + duration_s)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        if snap is not None:
+            snap.stop()
+        n = sum(served)
+        all_lat = sorted(x for xs in lat for x in xs)
+        qps = n / duration_s if duration_s > 0 else 0.0
+        p50 = all_lat[len(all_lat) // 2] if all_lat else 0
+        p99 = all_lat[int(len(all_lat) * 0.99)] if all_lat else 0
+        log_info(f"serve: {qps:,.0f} q/s over {duration_s}s "
+                 f"({clients} clients, batching="
+                 f"{'on' if Global.enable_batching else 'off'}, "
+                 f"p50 {p50:,}us, p99 {p99:,}us, "
+                 f"{sum(errors)} errors)")
+        return {"qps": round(qps, 1), "served": n, "errors": sum(errors),
+                "clients": clients, "duration_s": duration_s,
+                "batching": bool(Global.enable_batching),
+                "p50_us": int(p50), "p99_us": int(p99)}
 
     # ------------------------------------------------------------------
     @staticmethod

@@ -13,6 +13,8 @@ Glues parser -> planner -> engine and implements the reference's query modes:
 
 from __future__ import annotations
 
+import threading
+
 import numpy as np
 
 from wukong_tpu.config import Global
@@ -21,10 +23,18 @@ from wukong_tpu.obs import (
     get_recorder,
     get_registry,
     maybe_device_trace,
+    maybe_start_metrics_http,
     maybe_start_trace,
 )
 from wukong_tpu.planner.heuristic import heuristic_plan
 from wukong_tpu.planner.plan_file import set_plan
+from wukong_tpu.runtime.batcher import (
+    _M_PARSE_CACHE,
+    PlanCache,
+    QueryBatcher,
+    snapshot_patterns,
+    template_signature,
+)
 from wukong_tpu.runtime.monitor import Monitor
 from wukong_tpu.runtime.resilience import Deadline
 from wukong_tpu.sparql.ir import SPARQLQuery, SPARQLTemplate
@@ -32,7 +42,23 @@ from wukong_tpu.sparql.parser import Parser
 from wukong_tpu.types import IN, OUT, is_tpid
 from wukong_tpu.utils.errors import ErrorCode, WukongError
 from wukong_tpu.utils.logger import log_error, log_info
+from wukong_tpu.utils.lru import LRUCache
 from wukong_tpu.utils.timer import get_usec
+
+
+# ceiling on how long a serving thread waits for a coalesced dispatch to
+# settle (the stream lane's STREAM_WAIT_TIMEOUT_S analogue) — a wedged
+# batcher surfaces as an error, never as a hung client
+BATCH_WAIT_TIMEOUT_S = 600.0
+
+
+def _batch_wait_timeout(q) -> float:
+    dl = getattr(q, "deadline", None)
+    if dl is not None:
+        rem = dl.remaining_s()
+        if rem is not None:
+            return min(rem + 60.0, BATCH_WAIT_TIMEOUT_S)
+    return BATCH_WAIT_TIMEOUT_S
 
 
 class Proxy:
@@ -54,6 +80,15 @@ class Proxy:
             labels=("status",))
         self._pool = None
         self._stream = None
+        # serving fast path: parse cache (query text -> parsed query) and
+        # plan cache (template signature + store version -> plan recipe);
+        # the batcher itself starts lazily on the first batched dispatch
+        self._parse_cache = LRUCache(Global.parse_cache_size)
+        self._plan_cache = PlanCache(Global.plan_cache_size)
+        self._batcher: QueryBatcher | None = None
+        self._batcher_init_lock = threading.Lock()
+        # metrics scrape endpoint (metrics_port knob; no-op when 0/off)
+        maybe_start_metrics_http()
         # surface the sharded store's per-shard breaker in the rolling
         # throughput report (resilience observability, PR 1 follow-up)
         breaker = getattr(getattr(dist_engine, "sstore", None), "breaker", None)
@@ -74,6 +109,26 @@ class Proxy:
         return self._pool
 
     # ------------------------------------------------------------------
+    def _parse_text(self, text: str) -> SPARQLQuery:
+        """Parse with the bounded-LRU parse cache: repeated query texts
+        skip the parser entirely. Entries are pickled blobs — loads() is
+        several times cheaper than deepcopy on the serving fast path, and
+        every hit gets a pristine query (no execution-state leaks)."""
+        import pickle
+
+        blob = self._parse_cache.get(text)
+        if blob is not None:
+            _M_PARSE_CACHE.labels(outcome="hit").inc()
+            return pickle.loads(blob)
+        _M_PARSE_CACHE.labels(outcome="miss").inc()
+        q = Parser(self.str_server).parse(text)
+        try:
+            self._parse_cache.put(
+                text, pickle.dumps(q, protocol=pickle.HIGHEST_PROTOCOL))
+        except Exception:  # unpicklable artifact: skip caching, stay correct
+            pass
+        return q
+
     def _plan(self, q: SPARQLQuery, plan_text: str | None = None) -> None:
         if plan_text is not None:
             if Global.enable_planner:
@@ -82,10 +137,23 @@ class Proxy:
                 raise WukongError(ErrorCode.UNKNOWN_PLAN, "bad plan file")
             else:
                 return
+        # plan cache: same template signature + same store version replays
+        # the recorded plan recipe (dynamic inserts / stream commits bump
+        # the version, so stale plans never apply)
+        sig = template_signature(q)
+        version = (getattr(self.g, "version", 0),
+                   self.planner is not None and Global.enable_planner)
+        if sig is not None and self._plan_cache.lookup(q, sig, version):
+            return
+        parsed = snapshot_patterns(q) if sig is not None else None
         if self.planner is not None and Global.enable_planner:
             if self.planner.generate_plan(q):
+                if sig is not None:
+                    self._plan_cache.record(parsed, q, sig, version)
                 return
         heuristic_plan(q)
+        if sig is not None:
+            self._plan_cache.record(parsed, q, sig, version)
 
     def _engine_for(self, q: SPARQLQuery, device: str | None):
         if device == "tpu" or (device is None and Global.enable_tpu and self.tpu):
@@ -115,11 +183,11 @@ class Proxy:
 
         def prepare():
             if trace is None:
-                qq = Parser(self.str_server).parse(text)
+                qq = self._parse_text(text)
                 self._plan_prepared(qq, blind, plan_text)
                 return qq
             with trace.span("proxy.parse"):
-                qq = Parser(self.str_server).parse(text)
+                qq = self._parse_text(text)
             qq.trace = trace
             qq.qid = trace.qid
             with trace.span("proxy.plan"):
@@ -183,7 +251,7 @@ class Proxy:
             q = prepare()
             eng = self._engine_for(q, device)
             t0 = get_usec()
-            eng.execute(q)
+            self._serve_execute(q, eng, pinned=device is not None)
             total_us += get_usec() - t0
             if (q.result.status_code == ErrorCode.UNSUPPORTED_SHAPE
                     and eng is self.dist):
@@ -233,6 +301,81 @@ class Proxy:
         # (query_deadline_ms / query_budget_rows; None when both off)
         qq.deadline = Deadline.from_config()
         self._plan(qq, plan_text)
+
+    # ------------------------------------------------------------------
+    # serving-path micro-batching (runtime/batcher.py)
+    # ------------------------------------------------------------------
+    def batcher(self) -> "QueryBatcher":
+        """Lazily-started request coalescer. Groups ride the engine pool's
+        batch lane when the pool is running, else they run inline on the
+        batcher's flusher thread."""
+        if self._batcher is None:
+            with self._batcher_init_lock:  # concurrent first dispatches
+                if self._batcher is None:  # must share ONE coalescer
+                    cpu = self.cpu or (self.tpu.cpu
+                                       if self.tpu is not None else None)
+                    self._batcher = QueryBatcher(cpu, self.tpu,
+                                                 pool=lambda: self._pool)
+        return self._batcher
+
+    def _serve_execute(self, q: SPARQLQuery, eng,
+                       pinned: bool = False) -> SPARQLQuery:
+        """One serving-path dispatch: with ``enable_batching`` on,
+        compatible queries coalesce into fused device dispatches; the
+        default (off) and every bypass go straight to the engine — the
+        single allowlisted direct-dispatch site for interactive queries.
+        ``pinned`` (an explicit device= request) always bypasses: the
+        batcher picks its own engine, which would silently override the
+        caller's pin."""
+        if Global.enable_batching and not pinned and eng is not None \
+                and eng is not self.dist:
+            pend = self.batcher().offer(q)
+            if pend is not None:
+                timeout = _batch_wait_timeout(q)
+                try:
+                    pend.wait(timeout)
+                except TimeoutError:
+                    # a wedged batcher must not hang the serving thread
+                    # forever (the stream lane bounds its wait the same
+                    # way) — surface the failure instead
+                    log_error(f"batched dispatch not settled in "
+                              f"{timeout:.0f}s; batcher wedged?")
+                    raise
+                return q
+        eng.execute(q)  # batcher bypass: direct dispatch
+        return q
+
+    def serve_query(self, text: str, blind: bool | None = None,
+                    device: str | None = None) -> SPARQLQuery:
+        """The lean serving entry (no repeats, no result printing): parse
+        (cached) -> plan (cached) -> batched or direct execution, with the
+        same shape/capacity fallbacks as run_single_query. This is the
+        path live traffic takes; run_single_query is the console surface."""
+        trace = maybe_start_trace(kind="query", text=text)
+
+        def prepare():
+            qq = self._parse_text(text)
+            if trace is not None:
+                qq.trace = trace
+                qq.qid = trace.qid
+            self._plan_prepared(qq, blind, None)
+            return qq
+
+        try:
+            with activate(trace):
+                q, _us = self._run_repeats(prepare, 1, device, trace)
+        except Exception as e:
+            code = e.code if isinstance(e, WukongError) else "ERROR"
+            self._m_queries.labels(
+                status=code.name if isinstance(code, ErrorCode)
+                else str(code)).inc()
+            if trace is not None:
+                self.recorder.on_complete(trace, code)
+            raise
+        self._m_queries.labels(status=q.result.status_code.name).inc()
+        if trace is not None:
+            self.recorder.on_complete(trace, q.result.status_code)
+        return q
 
     def print_result(self, q: SPARQLQuery, rows: int) -> None:
         """Render rows through the string server (proxy.hpp:247-294)."""
@@ -292,6 +435,9 @@ class Proxy:
         if self.dist is not None and self.dist.sstore.check_version():
             # compiled chains bake per-segment probe/depth bounds
             self._fn_cache_clear()
+        # plan recipes are version-keyed (stale ones can never apply), but
+        # an insert obsoletes every cached plan's cost basis — free them
+        self._plan_cache.clear()
         log_info(f"dynamic load: {n:,} new subject-side edges from {dirname}")
 
     # ------------------------------------------------------------------
@@ -352,6 +498,7 @@ class Proxy:
         rec = self.stream_context().feed(triples, ts=ts)
         if self.dist is not None and self.dist.sstore.check_version():
             self._fn_cache_clear()
+        self._plan_cache.clear()  # stream commit: same contract as load -d
         return rec
 
     def _fn_cache_clear(self) -> None:

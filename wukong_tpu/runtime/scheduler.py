@@ -52,12 +52,13 @@ _POOLS: "weakref.WeakSet" = weakref.WeakSet()
 
 def _queue_depth() -> int:
     return sum(sum(len(dq) for dq in p.queues) + len(p.stream_queue)
+               + len(p.batch_queue)
                for p in list(_POOLS))
 
 
 get_registry().gauge(
     "wukong_pool_queue_depth",
-    "Queries waiting in pool queues (incl. stream lane)"
+    "Queries waiting in pool queues (incl. stream + batch lanes)"
 ).set_function(_queue_depth)
 
 
@@ -94,6 +95,14 @@ class EnginePool:
         # stream lane: shared low-priority queue for standing-query work
         self.stream_queue = collections.deque()
         self._stream_lock = threading.Lock()
+        # batch lane: coalesced serving-path groups (runtime/batcher.py).
+        # A group is ONE item — work stealing cannot split it — popped
+        # right after the engine's own queue (batched queries are
+        # interactive traffic, unlike the stream lane's background work).
+        # Groups deliver results through their members' futures, so items
+        # here are fire-and-forget for the pool's result bookkeeping.
+        self.batch_queue = collections.deque()
+        self._batch_lock = threading.Lock()
         # stream-lane qids are reserved for wait(): poll() skips them, so
         # an open-loop poll() consumer (the emulator) sharing this pool
         # can't steal the stream context's completions
@@ -167,8 +176,15 @@ class EnginePool:
         self._inflight[tid] = None
         if item is not None:
             qid, _q = item
-            self._fail(qid, RuntimeError(
-                f"engine-{tid} crashed executing query {qid}: {exc!r}"))
+            if qid is None:  # batch-lane group: settle its member futures
+                fail = getattr(_q, "fail_all", None)
+                if fail is not None:
+                    fail(RuntimeError(
+                        f"engine-{tid} crashed executing a fused batch: "
+                        f"{exc!r}"))
+            else:
+                self._fail(qid, RuntimeError(
+                    f"engine-{tid} crashed executing query {qid}: {exc!r}"))
         self._respawns[tid] += 1
         _M_RESPAWNS.inc()
         if self._respawns[tid] <= self.MAX_RESPAWNS and not self._stop.is_set():
@@ -204,6 +220,14 @@ class EnginePool:
                 for item in stream_stranded:
                     self._end_queue_span(item[1], dead_pool=True)
                     self._fail(item[0], RuntimeError("engine pool dead"))
+                # ...or the batch lane: settle fused groups' member futures
+                with self._batch_lock:
+                    batch_stranded = list(self.batch_queue)
+                    self.batch_queue.clear()
+                for _qid, group in batch_stranded:
+                    fail = getattr(group, "fail_all", None)
+                    if fail is not None:
+                        fail(RuntimeError("engine pool dead"))
 
     # ------------------------------------------------------------------
     def submit(self, query, tid: int | None = None,
@@ -214,7 +238,24 @@ class EnginePool:
         lane="stream" bypasses per-engine routing into the shared
         low-priority stream queue: any engine drains it, but only after its
         own queue and its steal targets are empty (standing-query work never
-        displaces interactive queries)."""
+        displaces interactive queries).
+
+        lane="batch" enqueues a coalesced FusedGroup (runtime/batcher.py)
+        as ONE indivisible item; the group delivers results through its
+        members' futures, so no pool-side result entry is created (returns
+        -1). A dead pool fails the group immediately via fail_all."""
+        if lane == "batch":
+            _M_SUBMITTED.labels(lane="batch").inc()
+            with self._route_lock:
+                if all(self._dead[k] for k in range(self.n)):
+                    fail = getattr(query, "fail_all", None)
+                    if fail is not None:
+                        fail(RuntimeError("engine pool dead"))
+                    return -1
+                with self._batch_lock:
+                    self.batch_queue.append((None, query))
+            self._pending.release()
+            return -1
         with self._results_lock:
             qid = self._next_qid
             self._next_qid += 1
@@ -301,6 +342,11 @@ class EnginePool:
         with self.locks[tid]:
             if self.queues[tid]:
                 return self.queues[tid].popleft()
+        # batch lane next: coalesced groups are interactive traffic, popped
+        # whole (a group is one item — stealing can never split it)
+        with self._batch_lock:
+            if self.batch_queue:
+                return self.batch_queue.popleft()
         # steal from neighbors (back — leave the owner its freshest work)
         for nb in self._neighbors(tid):
             with self.locks[nb]:
@@ -336,6 +382,23 @@ class EnginePool:
             qid, query = item
             self._inflight[tid] = item
             self._busy_since[tid] = get_usec()
+            if qid is None:  # batch lane: a fused group, fire-and-forget
+                try:
+                    from wukong_tpu.runtime import faults
+
+                    faults.site("pool.execute", shard=tid)
+                    query.run(engine)
+                except Exception as e:
+                    # run() settles its members on internal errors; this
+                    # catches the re-raise (and fault injection) so the
+                    # engine thread survives — fail_all is idempotent
+                    fail = getattr(query, "fail_all", None)
+                    if fail is not None:
+                        fail(e)
+                self._busy_since[tid] = 0
+                self._inflight[tid] = None
+                self._respawns[tid] = 0
+                continue
             # close the queue span opened at submit (the wait IS the span)
             self._end_queue_span(query, engine=tid)
             try:

@@ -1,0 +1,72 @@
+"""Shared bounded-LRU cache helper.
+
+One implementation for every memoization site that previously grew without
+bound or wholesale-cleared at a size threshold (the TPU engine's
+``_est_cache`` used to ``clear()`` everything at 4096 entries, so a hot
+mixed workload periodically lost every estimate). Eviction is
+least-recently-*used*: ``get`` refreshes recency, ``put`` evicts the
+coldest entry once ``maxsize`` is exceeded.
+
+Thread-safe: all operations hold one lock. The payloads cached here
+(pattern-tuple row estimates, parsed queries, plan recipes) are small and
+the operations are dict moves, so the lock is never contended for long.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+_MISS = object()
+
+
+class LRUCache:
+    """Bounded mapping with least-recently-used eviction."""
+
+    def __init__(self, maxsize: int = 1024):
+        self.maxsize = max(int(maxsize), 1)
+        self._d: OrderedDict = OrderedDict()
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def get(self, key, default=None):
+        with self._lock:
+            v = self._d.get(key, _MISS)
+            if v is _MISS:
+                self.misses += 1
+                return default
+            self._d.move_to_end(key)
+            self.hits += 1
+            return v
+
+    def put(self, key, value) -> None:
+        with self._lock:
+            self._d[key] = value
+            self._d.move_to_end(key)
+            while len(self._d) > self.maxsize:
+                self._d.popitem(last=False)
+                self.evictions += 1
+
+    def pop(self, key, default=None):
+        with self._lock:
+            return self._d.pop(key, default)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._d.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._d)
+
+    def __contains__(self, key) -> bool:
+        with self._lock:
+            return key in self._d
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"size": len(self._d), "maxsize": self.maxsize,
+                    "hits": self.hits, "misses": self.misses,
+                    "evictions": self.evictions}
