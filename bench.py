@@ -165,6 +165,7 @@ def _ensure_world(scale: int):
     )
     from wukong_tpu.store.gstore import build_partition
     from wukong_tpu.store.persist import load_gstore, save_gstore
+    from wukong_tpu.utils.errors import WukongError
 
     from wukong_tpu.planner.stats import Stats
 
@@ -187,9 +188,14 @@ def _ensure_world(scale: int):
                 print(f"# triples cache save failed: {e}", file=sys.stderr)
         return tri
 
+    g = None
     if os.path.exists(store_path):
-        g = load_gstore(store_path)
-    else:
+        try:
+            g = load_gstore(store_path)
+        except WukongError as e:  # corrupt/stale cache: rebuild, don't die
+            print(f"# store cache invalid ({e}); rebuilding", file=sys.stderr)
+            os.remove(store_path)
+    if g is None:
         triples = load_tri()
         g = build_partition(triples, 0, 1)
         try:
@@ -632,9 +638,16 @@ def watdiv_main(device_ok: bool) -> None:
     store_path = os.path.join(CACHE, f"watdiv{scale}_p0.npz")
     ss = VirtualWatdivStrings(scale, seed=0)
     t0 = time.time()
+    from wukong_tpu.utils.errors import WukongError
+
+    g = None
     if os.path.exists(store_path):
-        g = load_gstore(store_path)
-    else:
+        try:
+            g = load_gstore(store_path)
+        except WukongError as e:  # corrupt/stale cache: rebuild, don't die
+            print(f"# store cache invalid ({e}); rebuilding", file=sys.stderr)
+            os.remove(store_path)
+    if g is None:
         triples, _ = generate_watdiv(scale, seed=0)
         g = build_partition(triples, 0, 1)
         del triples

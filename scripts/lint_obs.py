@@ -16,6 +16,14 @@ allowlisted bypass sites: interactive dispatches must flow through
 future code can't silently reopen a one-query-per-dispatch path next to the
 coalescer. The allowlist names the sites that ARE the serving machinery.
 
+Gate 3 — mutation durability: any function that calls ``insert_triples(``
+(the primary-store mutation entry) must route through the WAL append hook
+``maybe_wal_append(`` in the same top-level function, or be allowlisted.
+Acknowledged mutations that skip the WAL are silently lost on a crash —
+exactly the gap this gate keeps closed. The allowlist names derived-state
+writers (window stores rebuild from WAL-logged epochs) and the recovery
+replay itself (which applies records under WAL suppression).
+
 Run standalone (``python scripts/lint_obs.py``) or via the test suite
 (tests/test_obs.py::test_lint_obs_gate, tests/test_batcher.py). Exit code 1
 + one line per violation when a gate fails.
@@ -43,6 +51,19 @@ EXECUTE_ALLOWLIST = {
     ("batcher.py", "_run_fused"),     # the fused dispatch itself
 }
 
+# (package-relative file, top-level function) pairs allowed to call
+# ``insert_triples(`` without the WAL append hook
+WAL_ALLOWLIST = {
+    # the per-partition mutation primitive itself (hooked at batch level)
+    ("store/dynamic.py", "insert_triples"),
+    # private window store: derived state, rebuilt from WAL-logged epochs
+    ("stream/continuous.py", "_on_epoch_windowed"),
+    # recovery replay re-applies durable records under WAL suppression
+    # (boot) or onto a not-yet-promoted partition under the mutation lock
+    ("runtime/recovery.py", "_replay_wal"),
+    ("runtime/recovery.py", "_rebuild_shard_locked"),
+}
+
 
 class _PrintFinder(ast.NodeVisitor):
     def __init__(self):
@@ -60,6 +81,43 @@ class _PrintFinder(ast.NodeVisitor):
         if (isinstance(node.func, ast.Name) and node.func.id == "print"
                 and not (set(self.func_stack) & ALLOWED_FUNCS)):
             self.hits.append(node.lineno)
+        self.generic_visit(node)
+
+
+class _MutationFinder(ast.NodeVisitor):
+    """Per TOP-LEVEL function: does it (or any nested def) call
+    ``insert_triples`` / the WAL hook ``maybe_wal_append``? Nested defs
+    attribute to their outermost function — the hook protects the whole
+    batch path, wherever the loop body lives."""
+
+    def __init__(self):
+        self.func_stack: list[str] = []
+        # top-level func -> (first insert lineno, saw_hook)
+        self.funcs: dict[str, list] = {}
+
+    def visit_FunctionDef(self, node):
+        self.func_stack.append(node.name)
+        self.generic_visit(node)
+        self.func_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _name_of(self, func) -> str:
+        if isinstance(func, ast.Name):
+            return func.id
+        if isinstance(func, ast.Attribute):
+            return func.attr
+        return ""
+
+    def visit_Call(self, node):
+        name = self._name_of(node.func)
+        if name in ("insert_triples", "maybe_wal_append") and self.func_stack:
+            top = self.func_stack[0]
+            ent = self.funcs.setdefault(top, [None, False])
+            if name == "insert_triples" and ent[0] is None:
+                ent[0] = node.lineno
+            if name == "maybe_wal_append":
+                ent[1] = True
         self.generic_visit(node)
 
 
@@ -113,6 +171,17 @@ def violations(pkg_root: str) -> list[str]:
                     "Proxy._serve_execute or extend EXECUTE_ALLOWLIST)"
                     for ln, func in ef.hits
                     if (fn, func) not in EXECUTE_ALLOWLIST)
+            mf = _MutationFinder()
+            mf.visit(tree)
+            rel_posix = rel.replace(os.sep, "/")
+            out.extend(
+                f"{rel}:{ln}: insert_triples() without the WAL append "
+                "hook — an acknowledged mutation this path commits is "
+                "lost on crash (call maybe_wal_append before mutating, "
+                "or extend WAL_ALLOWLIST for derived-state writers)"
+                for func, (ln, hooked) in sorted(mf.funcs.items())
+                if ln is not None and not hooked
+                and (rel_posix, func) not in WAL_ALLOWLIST)
     return out
 
 

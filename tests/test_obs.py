@@ -350,11 +350,13 @@ def test_chaos_sharded_fetch_spans_in_dist_trace(world, monkeypatch):
     store.stores = [g]
     store.breaker = CircuitBreaker()
     store.degraded_shards = set()
+    store.failover_shards = set()
+    store.replicas = {}
     faults.install(FaultPlan([FaultSpec("dist.shard_fetch", "transient",
                                         count=1)], seed=0))
     tr = QueryTrace(kind="query")
     with activate(tr):
-        out, ok = store._fetch_shard(0, lambda: "csr", "segment(7,0)")
+        out, ok = store._fetch_shard(0, lambda g: "csr", "segment(7,0)")
     assert (out, ok) == ("csr", True)
     [sp] = [s for s in tr.spans if s.name == "shard.fetch"]
     assert sp.attrs["shard"] == 0 and sp.attrs["ok"] is True

@@ -83,6 +83,33 @@ class GlobalConfig:
     breaker_threshold: int = 3
     breaker_cooldown_ms: int = 5000
 
+    # ---- fault tolerance / durability (store/wal.py, runtime/recovery.py,
+    # parallel/sharded_store.py replication) ----
+    # how many hosts hold each logical shard's data: 1 = no replication
+    # (today's behavior); k > 1 mirrors every shard onto its k-1 successor
+    # hosts, and a failed primary fetch transparently fails over to a
+    # replica instead of substituting an empty shard (results stay
+    # complete=True while any replica survives). Immutable: replicas are
+    # cloned when the sharded store is built.
+    replication_factor: int = 1
+    # write-ahead log for mutations (dynamic inserts + stream epochs):
+    # "" disables (default — the mutation hooks degrade to one str check).
+    # Records are length-prefixed + CRC-checksummed, appended BEFORE the
+    # mutation is acknowledged, rotated at wal_segment_mb, and truncated
+    # behind checkpoints.
+    wal_dir: str = ""
+    # fsync policy: none (OS buffering), interval (at most once per
+    # wal_sync_interval_s), always (every append — the durability of a
+    # classic redo log, at fsync cost per batch)
+    wal_sync: str = "none"
+    wal_sync_interval_s: int = 1
+    wal_segment_mb: int = 64
+    # crash-consistent checkpoints (base partitions + dynamic deltas +
+    # stream registry/window state): directory ("" = off) and the periodic
+    # checkpointer cadence (0 = manual `checkpoint` console verb only)
+    checkpoint_dir: str = ""
+    checkpoint_interval_s: int = 0
+
     # ---- observability knobs (wukong_tpu/obs/; all mutable) ----
     # per-query tracing (trace id + span stack, proxy->engine->shard-fetch).
     # Off by default: every hook degrades to one getattr/None check, so the
@@ -144,7 +171,7 @@ class GlobalConfig:
     _IMMUTABLE = {
         "num_workers", "num_proxies", "num_engines", "input_folder",
         "memstore_size_gb", "est_bdr_threshold", "enable_tpu", "tpu_mem_cache_gb",
-        "enable_dynamic_store", "enable_versatile",
+        "enable_dynamic_store", "enable_versatile", "replication_factor",
     }
 
     def finalize(self) -> None:

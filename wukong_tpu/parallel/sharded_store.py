@@ -50,7 +50,9 @@ class StackedIndex:
 
 
 class ShardedDeviceStore:
-    def __init__(self, stores: list, mesh, axis: str = "x"):
+    def __init__(self, stores: list, mesh, axis: str = "x",
+                 replication_factor: int | None = None):
+        from wukong_tpu.config import Global
         from wukong_tpu.runtime.resilience import CircuitBreaker
 
         self.stores = stores
@@ -67,6 +69,72 @@ class ShardedDeviceStore:
         # (the dist engine tags replies incomplete while it is non-empty)
         self.breaker = CircuitBreaker()
         self.degraded_shards: set[int] = set()
+        # fault tolerance: with replication_factor k > 1 each logical
+        # shard's data is mirrored onto its k-1 successor hosts; a failed
+        # primary fetch fails over to a replica instead of substituting an
+        # empty shard, and failover_shards records primaries currently
+        # served by replicas (the recovery manager's rebuild signal)
+        k = (Global.replication_factor if replication_factor is None
+             else replication_factor)
+        self.replication_factor = max(1, min(int(k), self.D))
+        self.replicas: dict[int, list] = {}  # shard -> [(host, GStore)]
+        self.failover_shards: set[int] = set()
+        if self.replication_factor > 1:
+            self.refresh_replicas()
+
+    def refresh_replicas(self) -> None:
+        """(Re)clone every shard's replicas from its current primary —
+        called at construction and after a checkpoint restore (the old
+        clones would otherwise mirror a dead store's state)."""
+        from wukong_tpu.store.persist import clone_gstore
+
+        self.replicas = {
+            i: [((i + j) % self.D, clone_gstore(self.stores[i]))
+                for j in range(1, self.replication_factor)]
+            for i in range(self.D)}
+
+    def invalidate_stagings(self) -> None:
+        """Drop every staged segment so the next query re-fetches from the
+        host partitions (the kill-and-recover drill's model of losing a
+        host: its staged device data dies with it)."""
+        self._cache.clear()
+        self._index_cache.clear()
+        self.bytes_used = 0
+
+    def replica_stores(self) -> list:
+        """Every replica GStore (mutation fan-out targets: an insert that
+        reaches a primary must reach its mirrors, or failover would serve
+        stale data)."""
+        return [rg for reps in self.replicas.values() for (_h, rg) in reps]
+
+    def rebuild_shard(self, i: int, store=None, source: str = "replica"
+                      ) -> bool:
+        """Promote a rebuilt partition as shard ``i``'s primary: install
+        it, close the breaker, clear the degradation flags, and drop
+        stagings so the next query fetches from the healed primary. With
+        no explicit ``store`` the first surviving replica is cloned.
+        Returns False when there is nothing to rebuild from."""
+        from wukong_tpu.obs.metrics import get_registry
+        from wukong_tpu.obs.trace import trace_event
+        from wukong_tpu.store.persist import clone_gstore
+
+        if store is None:
+            reps = self.replicas.get(int(i))
+            if not reps:
+                return False
+            store = clone_gstore(reps[0][1])
+        self.stores[int(i)] = store
+        self.breaker.record_success(int(i))  # promote: close the breaker
+        self.degraded_shards.discard(int(i))
+        self.failover_shards.discard(int(i))
+        self.invalidate_stagings()
+        trace_event("shard.rebuild", shard=int(i), source=source)
+        get_registry().counter(
+            "wukong_recovery_rebuilds_total",
+            "Failed shards rebuilt and promoted",
+            labels=("shard", "source")).labels(shard=int(i),
+                                               source=source).inc()
+        return True
 
     def version(self) -> int:
         """Max dynamic-insert version across all partitions."""
@@ -85,6 +153,8 @@ class ShardedDeviceStore:
             self._seen_version = v
             # stagings are gone, so no staged data is missing any shard;
             # the next staging re-evaluates shard health through the breaker
+            # (failover_shards persists — it tracks the primary's health for
+            # the recovery manager, not this staging's completeness)
             self.degraded_shards.clear()
             return True
         return False
@@ -92,15 +162,18 @@ class ShardedDeviceStore:
     def _fetch_shard(self, i: int, fn, what: str):
         """One shard's host-side fetch through the resilience layer: the
         ``dist.shard_fetch`` fault site, retry with backoff on transients,
-        and the per-shard circuit breaker. Returns (value, ok); ok=False
-        marks the shard degraded — the caller substitutes empty shard data
-        so the compiled chain routes around the shard instead of crashing.
-        A later successful fetch clears the degraded flag (recovery).
+        the per-shard circuit breaker, and — with replication on — failover
+        to the shard's successor-host replicas. ``fn(store)`` reads one
+        partition; the primary is tried first, then each replica. Returns
+        (value, ok); ok=False means primary AND replicas all failed — the
+        caller substitutes empty shard data so the compiled chain routes
+        around the shard instead of crashing. A later successful primary
+        fetch clears the degraded/failover flags (recovery).
 
         Observability: when the executing query is traced, each fetch is a
         ``shard.fetch`` span on the ambient trace — retry attempts, breaker
-        trips, and injected fault sites land on it as span events (the
-        retry/breaker/fault hooks use the same ambient trace)."""
+        trips, failovers, and injected fault sites land on it as span
+        events (the retry/breaker/fault hooks use the same ambient trace)."""
         from wukong_tpu.obs import trace as obs_trace
 
         tr = obs_trace.current()
@@ -123,28 +196,67 @@ class ShardedDeviceStore:
 
         def attempt():
             faults.site("dist.shard_fetch", shard=i)
-            return fn()
+            return fn(self.stores[i])
 
         try:
             out = retry_call(attempt, site=f"dist.shard_fetch[{i}]",
                              retry_on=(faults.TransientFault,),
                              breaker=self.breaker, key=i)
-        except faults.ShardDown as e:
-            # persistent fault: not retryable — retry_call already counted
-            # it toward the breaker, so repeated stagings trip it and stop
-            # touching the shard
-            log_warn(f"shard {i} down during {what} ({e}); substituting an "
-                     "empty shard — results will be flagged incomplete")
-            self._mark_degraded(i)
-            return None, False
-        except (ShardUnavailable, RetryExhausted) as e:
-            log_warn(f"shard {i} unavailable during {what} "
-                     f"({e.code.name}); substituting an empty shard — "
+        except (faults.ShardDown, ShardUnavailable, RetryExhausted) as e:
+            # the primary is gone for this staging (persistent fault, open
+            # breaker, or exhausted retries — retry_call already counted
+            # the failure toward the breaker, so repeated stagings trip it
+            # and stop touching the shard). With replication, fail over.
+            got = self._fetch_failover(i, fn, what)
+            if got is not None:
+                return got[0], True
+            code = e.code.name if isinstance(e, (ShardUnavailable,
+                                                 RetryExhausted)) else str(e)
+            log_warn(f"shard {i} unavailable during {what} ({code}) and no "
+                     "replica answered; substituting an empty shard — "
                      "results will be flagged incomplete")
             self._mark_degraded(i)
             return None, False
         self.degraded_shards.discard(i)
+        self.failover_shards.discard(i)
         return out, True
+
+    def _fetch_failover(self, i: int, fn, what: str):
+        """Try shard ``i``'s replicas in successor order; returns (value,)
+        on the first success (the 1-tuple distinguishes a successful None
+        fetch from exhaustion), or None when every replica failed too.
+        Replica fetches get their own ``replica.fetch`` fault site and
+        their own breaker keys, so a sick replica host is routed around
+        independently of its primary."""
+        from wukong_tpu.obs.metrics import get_registry
+        from wukong_tpu.obs.trace import trace_event
+        from wukong_tpu.runtime import faults
+        from wukong_tpu.runtime.resilience import retry_call
+        from wukong_tpu.utils.errors import RetryExhausted, ShardUnavailable
+        from wukong_tpu.utils.logger import log_warn
+
+        for host, rg in self.replicas.get(i, []):
+            def attempt(rg=rg, host=host):
+                faults.site("replica.fetch", shard=host)
+                return fn(rg)
+
+            try:
+                out = retry_call(attempt, site=f"replica.fetch[{i}->{host}]",
+                                 retry_on=(faults.TransientFault,),
+                                 breaker=self.breaker, key=(i, host))
+            except (faults.ShardDown, ShardUnavailable, RetryExhausted) as e:
+                log_warn(f"replica {i}->{host} unavailable during {what} "
+                         f"({e!r:.80}); trying the next replica")
+                continue
+            self.failover_shards.add(i)
+            self.degraded_shards.discard(i)
+            trace_event("shard.failover", shard=i, replica=host)
+            get_registry().counter(
+                "wukong_failover_total",
+                "Shard fetches served by a replica after a primary failure",
+                labels=("shard",)).labels(shard=i).inc()
+            return (out,)
+        return None
 
     def _mark_degraded(self, i: int) -> None:
         from wukong_tpu.obs.metrics import get_registry
@@ -180,9 +292,8 @@ class ShardedDeviceStore:
 
         shards = []
         healthy = True
-        for i, g in enumerate(self.stores):
-            got, ok = self._fetch_shard(i, lambda g=g: fetch(g),
-                                        f"segment({pid},{d})")
+        for i in range(self.D):
+            got, ok = self._fetch_shard(i, fetch, f"segment({pid},{d})")
             healthy &= ok
             shards.append(got if ok else empty3)
         if all(len(k) == 0 for (k, _, _) in shards):
@@ -252,9 +363,9 @@ class ShardedDeviceStore:
                   np.empty(0, np.int64), np.empty(0, np.int64))
         shards = []
         healthy = True
-        for i, g in enumerate(self.stores):
+        for i in range(self.D):
             got, ok = self._fetch_shard(
-                i, lambda g=g: combined_adjacency(g, d),
+                i, lambda g: combined_adjacency(g, d),
                 f"versatile_segment({d})")
             healthy &= ok
             shards.append(got if ok else empty4)
@@ -320,10 +431,10 @@ class ShardedDeviceStore:
             return self._index_cache[key]
         lists = []
         healthy = True
-        for i, g in enumerate(self.stores):
+        for i in range(self.D):
             got, ok = self._fetch_shard(
-                i, lambda g=g: np.asarray(g.get_index(tpid, d),
-                                          dtype=np.int32),
+                i, lambda g: np.asarray(g.get_index(tpid, d),
+                                        dtype=np.int32),
                 f"index_list({tpid},{d})")
             healthy &= ok
             lists.append(got if ok else np.empty(0, np.int32))

@@ -35,6 +35,11 @@ trace [-q <qid|id>] [-n <k>] [-o <file>]
                              query's span tree by qid/trace id, or export
                              Chrome trace JSON (open in ui.perfetto.dev)
 metrics [-j]                 dump the metrics registry (Prometheus text, -j JSON)
+checkpoint                   write one atomic checkpoint (partitions + stream
+                             state) to checkpoint_dir; truncates covered WAL
+recover [-d <shard>]         restore newest checkpoint + replay the WAL tail;
+                             -d runs the kill-and-recover drill against one
+                             shard instead (requires --dist)
 """
 
 
@@ -84,6 +89,10 @@ class Console:
                 self._trace(rest)
             elif cmd == "metrics":
                 self._metrics(rest)
+            elif cmd == "checkpoint":
+                log_info(f"checkpoint written: {self.proxy.checkpoint()}")
+            elif cmd == "recover":
+                self._recover(rest)
             else:
                 log_error(f"unknown command: {cmd} (try 'help')")
         except WukongError as e:
@@ -221,6 +230,24 @@ class Console:
             print(f"({len(rec.dumps)} auto-dumped: "
                   + ", ".join(f"{r}:{t.trace_id}"
                               for r, t in list(rec.dumps)[-8:]) + ")")
+
+    def _recover(self, rest) -> None:
+        """recover: boot-style checkpoint+WAL restore. recover -d <shard>:
+        the kill-and-recover drill — force that primary down, prove
+        failover keeps results complete, heal, verify."""
+        ap = argparse.ArgumentParser(prog="recover")
+        ap.add_argument("-d", "--drill", type=int, default=None,
+                        metavar="shard")
+        ns = ap.parse_args(rest)
+        if ns.drill is None:
+            stats = self.proxy.recover()
+            log_info(f"recovered: checkpoint={stats['checkpoint']} "
+                     f"replayed={stats['replayed']} epoch={stats['epoch']}")
+            return
+        from wukong_tpu.runtime.emulator import Emulator
+
+        report = Emulator(self.proxy).run_drill(shard=ns.drill)
+        log_info(f"drill report: {report}")
 
     def _metrics(self, rest) -> None:
         from wukong_tpu.obs import get_registry

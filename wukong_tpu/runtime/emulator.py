@@ -434,11 +434,14 @@ class Emulator:
         """
         import threading
 
-        # NOTE: the pool is NOT started here — fused groups ride the batch
-        # lane only when a pool is already running (stream/emulator mixes);
-        # otherwise they dispatch inline on the batcher's flusher thread.
-        # On small hosts the idle engines' busy-poll would steal the very
-        # cores the fused dispatch needs.
+        # NOTE: the pool is not force-started here — fused groups ride the
+        # batch lane when a pool is already running (stream/emulator
+        # mixes) and dispatch inline on the batcher's flusher thread
+        # otherwise. Since the idle relax deepened to a 20ms-capped
+        # exponential backoff (scheduler.IDLE_SNOOZE_MAX_US, ROADMAP
+        # follow-up i — before/after in BENCH_SERVE.json idle_backoff), a
+        # co-located idle pool no longer starves the fused dispatches, so
+        # callers that keep the pool started are fine too.
         snap = maybe_start_snapshotter()
         stop = threading.Event()
         served = [0] * clients
@@ -489,6 +492,116 @@ class Emulator:
                 "clients": clients, "duration_s": duration_s,
                 "batching": bool(Global.enable_batching),
                 "p50_us": int(p50), "p99_us": int(p99)}
+
+    # ------------------------------------------------------------------
+    # kill-and-recover drill (fault-tolerance fire drill)
+    # ------------------------------------------------------------------
+    def run_drill(self, shard: int = 1, texts: list | None = None,
+                  rounds: int = 3) -> dict:
+        """Force one primary shard down mid-run and prove the recovery
+        story end to end: with replication, distributed results stay
+        ``complete=True`` via replica failover during the outage; after
+        the "host is replaced" (fault cleared) the recovery manager
+        rebuilds + promotes the primary and the verify round must match
+        the baseline. Returns the drill report (console ``recover -d``).
+        """
+        from wukong_tpu.obs.metrics import get_registry
+        from wukong_tpu.runtime import faults
+        from wukong_tpu.runtime.faults import FaultPlan, FaultSpec
+
+        proxy = self.proxy
+        if proxy.dist is None:
+            raise WukongError(ErrorCode.UNSUPPORTED_SHAPE,
+                              "the kill-and-recover drill needs the "
+                              "distributed engine (--dist)")
+        sstore = proxy.dist.sstore
+        m_failover = get_registry().counter(
+            "wukong_failover_total",
+            "Shard fetches served by a replica after a primary failure",
+            labels=("shard",))
+
+        def run_round() -> dict:
+            complete = True
+            nrows = []
+            for t in (texts or [None]):
+                q = self._drill_query(t)
+                proxy._serve_execute(q, proxy.dist, pinned=True)
+                complete &= bool(q.result.complete)
+                nrows.append(int(q.result.nrows))
+            return {"complete": complete, "nrows": nrows}
+
+        report = {"shard": int(shard),
+                  "replication_factor": sstore.replication_factor}
+        report["baseline"] = run_round()
+        f0 = m_failover.value(shard=str(shard))
+        # save any operator-installed chaos plan: the drill must not end a
+        # soak run's fault schedule as a side effect
+        prev_plan = faults.active()
+        faults.install(FaultPlan([FaultSpec("dist.shard_fetch",
+                                            "shard_down", shard=shard)]))
+        # the dead host's staged device data dies with it — force restaging
+        # so the outage actually exercises the fetch/failover path
+        sstore.invalidate_stagings()
+        try:
+            outage = [run_round() for _ in range(max(rounds, 1))]
+        finally:
+            faults.install(prev_plan)  # the dead host is replaced
+        report["outage"] = {
+            "rounds": len(outage),
+            "complete": all(r["complete"] for r in outage),
+            "nrows_match": all(r["nrows"] == report["baseline"]["nrows"]
+                               for r in outage),
+            "failovers": int(m_failover.value(shard=str(shard)) - f0),
+        }
+        # the recovery watcher may have healed in the background already
+        # (it races this explicit sweep by design); "healthy" is the
+        # invariant, the healed list just says who did the work
+        report["healed"] = proxy.recovery().heal_once(force=True)
+        report["healthy"] = not proxy.recovery().sick_shards()
+        verify = run_round()
+        report["recovered"] = {
+            "complete": verify["complete"],
+            "nrows_match": verify["nrows"] == report["baseline"]["nrows"],
+        }
+        log_info(f"drill shard={shard}: outage complete="
+                 f"{report['outage']['complete']} "
+                 f"(failovers={report['outage']['failovers']}), healthy="
+                 f"{report['healthy']}, recovered match="
+                 f"{report['recovered']['nrows_match']}")
+        return report
+
+    def _drill_query(self, text: str | None):
+        """A drill probe: the given SPARQL text, or a synthesized one-hop
+        scan over the most populous predicate index (works on any dataset
+        without a query file)."""
+        if text is not None:
+            q = Parser(self.proxy.str_server).parse(text)
+        else:
+            from wukong_tpu.sparql.ir import (
+                Pattern,
+                PatternGroup,
+                SPARQLQuery,
+            )
+            from wukong_tpu.types import IN, OUT
+
+            g = self.proxy.g
+            pid = max(
+                (k[0] for k, v in g.index.items()
+                 if k[1] == IN and k[0] not in g.type_ids and len(v)),
+                key=lambda p: len(g.index[(p, IN)]), default=None)
+            if pid is None:
+                raise WukongError(ErrorCode.UNKNOWN_PATTERN,
+                                  "no predicate index to drill against")
+            q = SPARQLQuery()
+            q.pattern_group = PatternGroup(
+                patterns=[Pattern(subject=-1, predicate=int(pid),
+                                  direction=OUT, object=-2)])
+            q.result.nvars = 2
+            q.result.required_vars = [-1, -2]
+        q.result.blind = True
+        q.deadline = Deadline.from_config()
+        self._plan(q)
+        return q
 
     # ------------------------------------------------------------------
     @staticmethod

@@ -22,6 +22,12 @@ Sites instrumented today:
   (``shard`` = partition sid; fires before any mutation, so retries are safe)
 - ``stream.ingest``     — per-epoch commit in stream/ingest.py (retried with
   backoff when dedup makes the batch idempotent)
+- ``wal.append``        — write-ahead-log append in store/wal.py (fires
+  before any bytes land: an injected failure fails the commit with both
+  the log and the store untouched — the batch was never acknowledged)
+- ``replica.fetch``     — failover fetch from a shard replica in
+  parallel/sharded_store.py (``shard`` = the replica HOST id)
+- ``checkpoint.write``  — checkpoint bundle write in runtime/recovery.py
 
 When no plan is installed every hook is a cheap no-op.
 """

@@ -87,6 +87,14 @@ class Proxy:
         self._plan_cache = PlanCache(Global.plan_cache_size)
         self._batcher: QueryBatcher | None = None
         self._batcher_init_lock = threading.Lock()
+        # fault tolerance: the recovery manager (checkpoint/restore + shard
+        # healing) starts lazily; its background threads launch here only
+        # when the knobs ask for them (zero-cost when off)
+        self._recovery = None
+        self._recovery_init_lock = threading.Lock()
+        if (Global.checkpoint_interval_s > 0 and Global.checkpoint_dir) or (
+                dist_engine is not None and Global.replication_factor > 1):
+            self.recovery().start()
         # metrics scrape endpoint (metrics_port knob; no-op when 0/off)
         maybe_start_metrics_http()
         # surface the sharded store's per-shard breaker in the rolling
@@ -463,11 +471,58 @@ class Proxy:
 
     def _insert_targets(self) -> list:
         """Every store online inserts must reach: the host partition first,
-        then the distributed shards (the `load -d` fan-out)."""
+        then the distributed shards (the `load -d` fan-out), then any
+        shard replicas — a mirror that missed a write would serve stale
+        data on failover."""
+        targets = [self.g]
+        if self.dist is not None:
+            targets += [g for g in self.dist.sstore.stores if g is not self.g]
+            targets += self.dist.sstore.replica_stores()
+        return targets
+
+    def _checkpoint_targets(self) -> list:
+        """The checkpointed primaries (no replicas: they are re-cloned
+        from the restored primaries, not persisted twice)."""
         targets = [self.g]
         if self.dist is not None:
             targets += [g for g in self.dist.sstore.stores if g is not self.g]
         return targets
+
+    # ------------------------------------------------------------------
+    # fault tolerance (runtime/recovery.py)
+    # ------------------------------------------------------------------
+    def recovery(self):
+        """Lazily-assembled RecoveryManager over this proxy's stores,
+        stream context, and sharded store."""
+        if self._recovery is None:
+            with self._recovery_init_lock:
+                if self._recovery is None:
+                    from wukong_tpu.runtime.recovery import RecoveryManager
+
+                    self._recovery = RecoveryManager(
+                        self._checkpoint_targets,  # live view across heals
+                        stream=self.stream_context(),
+                        sstore=getattr(self.dist, "sstore", None),
+                        pool=lambda: self._pool,
+                        on_change=self._on_store_change)
+        return self._recovery
+
+    def _on_store_change(self) -> None:
+        """Restore/rebuild invalidation: exactly the dynamic-insert
+        contract — compiled chains and cached plans must re-derive."""
+        if self.dist is not None and self.dist.sstore.check_version():
+            self._fn_cache_clear()
+        self._plan_cache.clear()
+
+    def checkpoint(self) -> str:
+        """Console `checkpoint` verb: write one atomic checkpoint bundle
+        (partitions + stream registry) and truncate the covered WAL."""
+        return self.recovery().checkpoint()
+
+    def recover(self) -> dict:
+        """Console `recover` verb: restore the newest checkpoint and
+        replay the WAL tail (boot-time crash recovery)."""
+        return self.recovery().recover()
 
     def stream_register(self, text: str, window=None, base_triples=None,
                         callback=None) -> int:

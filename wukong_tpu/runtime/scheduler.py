@@ -52,13 +52,13 @@ _POOLS: "weakref.WeakSet" = weakref.WeakSet()
 
 def _queue_depth() -> int:
     return sum(sum(len(dq) for dq in p.queues) + len(p.stream_queue)
-               + len(p.batch_queue)
+               + len(p.batch_queue) + len(p.rebuild_queue)
                for p in list(_POOLS))
 
 
 get_registry().gauge(
     "wukong_pool_queue_depth",
-    "Queries waiting in pool queues (incl. stream + batch lanes)"
+    "Queries waiting in pool queues (incl. stream/batch/rebuild lanes)"
 ).set_function(_queue_depth)
 
 
@@ -68,6 +68,21 @@ class EnginePool:
     # redistributed, and routing skips it. The reference has NO failure
     # handling at all (wukong.cpp:252 TODO; a dead pthread strands its ring).
     MAX_RESPAWNS = 3
+
+    # idle relax bounds (ROADMAP follow-up i): the reference busy-polls
+    # 10 -> 80us (engine.hpp:120-150), which keeps every idle engine waking
+    # 12.5k times/s — on this 2-core container a 4-engine idle pool burned
+    # a full core (each timed-semaphore wake costs ~170-500us of CPU here)
+    # and doubled co-located serve_query p50 (617us -> 1,230us). The
+    # semaphore acquire IS the wake-on-submit event (a submit releases a
+    # permit and wakes one sleeper immediately), so a deep cap costs
+    # nothing in pickup latency on the submit path; it only bounds the
+    # poll cadence for work that arrives via stealing races (an item
+    # stranded in a busy non-neighbor's queue). Measured at 20ms: idle
+    # burn ~100% -> ~11% of a core, co-located p50 restored to ~baseline
+    # (BENCH_SERVE.json idle_backoff).
+    IDLE_SNOOZE_MIN_US = 10
+    IDLE_SNOOZE_MAX_US = 20000
 
     def __init__(self, num_engines: int | None = None, make_engine=None):
         """make_engine(tid) -> object with .execute(query) (one per thread,
@@ -103,6 +118,13 @@ class EnginePool:
         # here are fire-and-forget for the pool's result bookkeeping.
         self.batch_queue = collections.deque()
         self._batch_lock = threading.Lock()
+        # rebuild lane: background shard-rebuild jobs (runtime/recovery.py
+        # RebuildJob), drained only when every other lane is empty —
+        # healing soaks idle capacity, never displaces serving traffic.
+        # Items share the batch lane's fire-and-forget contract
+        # (run(engine) + fail_all(exc)).
+        self.rebuild_queue = collections.deque()
+        self._rebuild_lock = threading.Lock()
         # stream-lane qids are reserved for wait(): poll() skips them, so
         # an open-loop poll() consumer (the emulator) sharing this pool
         # can't steal the stream context's completions
@@ -228,6 +250,14 @@ class EnginePool:
                     fail = getattr(group, "fail_all", None)
                     if fail is not None:
                         fail(RuntimeError("engine pool dead"))
+                # ...or the rebuild lane: same fire-and-forget settlement
+                with self._rebuild_lock:
+                    rebuild_stranded = list(self.rebuild_queue)
+                    self.rebuild_queue.clear()
+                for _qid, job in rebuild_stranded:
+                    fail = getattr(job, "fail_all", None)
+                    if fail is not None:
+                        fail(RuntimeError("engine pool dead"))
 
     # ------------------------------------------------------------------
     def submit(self, query, tid: int | None = None,
@@ -243,17 +273,23 @@ class EnginePool:
         lane="batch" enqueues a coalesced FusedGroup (runtime/batcher.py)
         as ONE indivisible item; the group delivers results through its
         members' futures, so no pool-side result entry is created (returns
-        -1). A dead pool fails the group immediately via fail_all."""
-        if lane == "batch":
-            _M_SUBMITTED.labels(lane="batch").inc()
+        -1). A dead pool fails the group immediately via fail_all.
+
+        lane="rebuild" enqueues a background shard-rebuild job
+        (runtime/recovery.py RebuildJob) with the same fire-and-forget
+        contract, drained only when every other lane is empty."""
+        if lane in ("batch", "rebuild"):
+            _M_SUBMITTED.labels(lane=lane).inc()
+            lock = self._batch_lock if lane == "batch" else self._rebuild_lock
+            queue = self.batch_queue if lane == "batch" else self.rebuild_queue
             with self._route_lock:
                 if all(self._dead[k] for k in range(self.n)):
                     fail = getattr(query, "fail_all", None)
                     if fail is not None:
                         fail(RuntimeError("engine pool dead"))
                     return -1
-                with self._batch_lock:
-                    self.batch_queue.append((None, query))
+                with lock:
+                    queue.append((None, query))
             self._pending.release()
             return -1
         with self._results_lock:
@@ -352,10 +388,15 @@ class EnginePool:
             with self.locks[nb]:
                 if self.queues[nb]:
                     return self.queues[nb].pop()
-        # stream lane last: standing-query work fills idle capacity only
+        # stream lane next-to-last: standing-query work fills idle capacity
         with self._stream_lock:
             if self.stream_queue:
                 return self.stream_queue.popleft()
+        # rebuild lane last: background shard healing is fully deferrable —
+        # failover keeps results complete while the rebuild waits
+        with self._rebuild_lock:
+            if self.rebuild_queue:
+                return self.rebuild_queue.popleft()
         return None
 
     def _run_engine(self, tid: int) -> None:
@@ -370,14 +411,20 @@ class EnginePool:
 
         get_binder().bind_thread(tid)  # no-op unless core binding is enabled
         engine = self._make_engine(tid)
-        snooze_us = 10
+        snooze_us = self.IDLE_SNOOZE_MIN_US
         while not self._stop.is_set():
             item = self._pop_work(tid)
             if item is None:
-                # adaptive snooze (engine.hpp:120-150: busy poll, then
-                # exponential 10 -> 80 us relax); semaphore bounds the sleep
+                # capped exponential idle backoff with wake-on-submit: the
+                # semaphore wakes a sleeper the moment anything is
+                # submitted, so deep relax costs no submit-path latency;
+                # the doubling only thins the *poll* cadence (10us ->
+                # IDLE_SNOOZE_MAX_US) so an idle pool no longer starves
+                # co-located fused dispatches (ROADMAP follow-up i —
+                # before/after in BENCH_SERVE.json idle_backoff)
                 got = self._pending.acquire(timeout=snooze_us / 1e6)
-                snooze_us = 10 if got else min(snooze_us * 2, 80)
+                snooze_us = (self.IDLE_SNOOZE_MIN_US if got
+                             else min(snooze_us * 2, self.IDLE_SNOOZE_MAX_US))
                 continue
             qid, query = item
             self._inflight[tid] = item

@@ -203,7 +203,21 @@ def load_dir_into(stores: list[GStore], dirname: str, dedup: bool = True) -> int
 
     triples = load_triples(dirname)
     check_vid_range(triples)  # once, not per store
-    total = 0
-    for g in stores:
-        total += insert_triples(g, triples, dedup, check_ids=False)
-    return total
+    return insert_batch_into(stores, triples, dedup)
+
+
+def insert_batch_into(stores: list[GStore], triples: np.ndarray,
+                      dedup: bool = True) -> int:
+    """One durable batch insert into every partition: the WAL append hook
+    fires BEFORE any store mutates, so an acknowledged batch is always
+    replayable and a WAL failure leaves the stores untouched. The mutation
+    lock keeps the append + fan-out atomic w.r.t. checkpoint
+    serialization (runtime/recovery.py)."""
+    from wukong_tpu.store.wal import maybe_wal_append, mutation_lock
+
+    with mutation_lock():
+        maybe_wal_append("insert", triples, dedup)
+        total = 0
+        for g in stores:
+            total += insert_triples(g, triples, dedup, check_ids=False)
+        return total

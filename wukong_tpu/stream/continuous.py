@@ -270,6 +270,63 @@ class ContinuousEngine:
         sq.sink = kept
         return dropped
 
+    # ------------------------------------------------------------------
+    # checkpoint surface (runtime/recovery.py)
+    # ------------------------------------------------------------------
+    def export_state(self) -> dict:
+        """Picklable snapshot of the standing-query registry: pristine
+        protos, per-term plans, standing result sets, sink history, and
+        window live-epoch bookkeeping. Window *stores* are excluded — they
+        rebuild deterministically from the live triples on import. Push
+        callbacks are process-local closures and cannot survive a restart;
+        they are recorded only as a flag so import can warn."""
+        qs = []
+        for sq in self.queries.values():
+            qs.append({
+                "qid": sq.qid, "proto": sq.proto, "text": sq.text,
+                "patterns": sq.patterns,
+                "required_vars": sq.required_vars, "nvars": sq.nvars,
+                "term_plans": sq.term_plans,
+                "window": ((sq.window.spec.size, sq.window.spec.slide)
+                           if sq.window is not None else None),
+                "window_live": (list(sq.window.live)
+                                if sq.window is not None else None),
+                "base_triples": sq.base_triples,
+                "seen": sq.seen, "sink": sq.sink,
+                "epochs_evaluated": sq.epochs_evaluated,
+                "degraded_epochs": sq.degraded_epochs,
+                "callback_errors": sq.callback_errors,
+                "had_callback": sq.callback is not None,
+            })
+        return {"next_qid": self._next_qid, "last_epoch": self.last_epoch,
+                "queries": qs}
+
+    def import_state(self, state: dict) -> None:
+        """Restore a registry snapshot (replacing the current registry);
+        window stores are rebuilt from the checkpointed live epochs."""
+        from wukong_tpu.stream.windows import EpochWindow, WindowSpec
+
+        self.queries.clear()
+        self._next_qid = int(state["next_qid"])
+        self.last_epoch = int(state["last_epoch"])
+        for d in state["queries"]:
+            sq = StandingQuery(
+                qid=d["qid"], proto=d["proto"], text=d["text"],
+                patterns=d["patterns"], required_vars=d["required_vars"],
+                nvars=d["nvars"], term_plans=d["term_plans"],
+                base_triples=d["base_triples"], seen=d["seen"],
+                sink=d["sink"], epochs_evaluated=d["epochs_evaluated"],
+                degraded_epochs=d["degraded_epochs"],
+                callback_errors=d["callback_errors"])
+            if d["window"] is not None:
+                sq.window = EpochWindow(spec=WindowSpec(*d["window"]),
+                                        live=list(d["window_live"]))
+                sq.wstore = self._build_window_store(sq)
+            if d["had_callback"]:
+                log_warn(f"standing query {sq.qid}: push callback did not "
+                         "survive the restart — re-register the sink")
+            self.queries[sq.qid] = sq
+
     def _validate(self, q: SPARQLQuery) -> None:
         pg = q.pattern_group
         if pg.unions or pg.optional:

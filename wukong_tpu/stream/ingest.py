@@ -198,6 +198,14 @@ class StreamIngestor:
             raise WukongError(ErrorCode.UNKNOWN_PATTERN,
                               f"epoch batch wants [N,3], got {triples.shape}")
         check_vid_range(triples)  # once per epoch, not per store
+        # durability first (store/wal.py): the epoch is logged BEFORE any
+        # store mutates, so a crash mid-apply replays it to completion and
+        # a WAL failure fails the commit with the stores untouched — either
+        # way no acknowledged epoch is ever lost. The mutation lock keeps
+        # the whole commit (log + insert fan-out + registry eval) atomic
+        # w.r.t. checkpoint serialization (runtime/recovery.py).
+        from wukong_tpu.store.wal import maybe_wal_append, mutation_lock
+
         # per-epoch trace (the stream lane's unit of work): ingest + eval
         # spans, recorded into the same flight recorder as query traces
         trace = maybe_start_trace(kind="stream")
@@ -214,7 +222,9 @@ class StreamIngestor:
                                               check_ids=False)
             return inserted[0]
 
-        with activate(trace):
+        with mutation_lock(), activate(trace):
+            maybe_wal_append("epoch", triples, self.dedup, ts=ts,
+                             epoch=self.epoch + 1)
             if trace is None:
                 n_ins = self._commit(_ingest)
             else:

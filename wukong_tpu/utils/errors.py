@@ -33,6 +33,7 @@ class ErrorCode(enum.IntEnum):
     CAPACITY_EXCEEDED = 16  # device capacity ceiling hit (host-fallback-able)
     SHARD_UNAVAILABLE = 17  # shard down / circuit breaker open
     RETRY_EXHAUSTED = 18  # transient-failure retries used up
+    CHECKPOINT_CORRUPT = 19  # checkpoint/WAL bundle unreadable or mismatched
 
 
 _MESSAGES = {
@@ -55,6 +56,7 @@ _MESSAGES = {
     ErrorCode.CAPACITY_EXCEEDED: "device capacity exceeded",
     ErrorCode.SHARD_UNAVAILABLE: "shard unavailable (circuit open)",
     ErrorCode.RETRY_EXHAUSTED: "transient-failure retries exhausted",
+    ErrorCode.CHECKPOINT_CORRUPT: "checkpoint/WAL bundle corrupt or incompatible",
 }
 
 
@@ -105,6 +107,18 @@ class RetryExhausted(WukongError):
     def __init__(self, detail: str = "", last: BaseException | None = None):
         self.last = last
         super().__init__(ErrorCode.RETRY_EXHAUSTED, detail)
+
+
+class CheckpointCorrupt(WukongError):
+    """A persisted bundle (gstore checkpoint, WAL segment, recovery
+    manifest) failed validation: truncated archive, checksum mismatch, or
+    a newer-major format this build refuses to guess at. Carries the
+    offending path so operators know which artifact to discard."""
+
+    def __init__(self, detail: str = "", path: str | None = None):
+        self.path = path
+        super().__init__(ErrorCode.CHECKPOINT_CORRUPT,
+                         f"{detail} ({path})" if path else detail)
 
 
 def assert_ec(cond: bool, code: ErrorCode, detail: str = "") -> None:
