@@ -1,195 +1,55 @@
 #!/usr/bin/env python
-"""Observability + serving-path sanity gates for wukong_tpu/ library code.
+"""Back-compat shim over wukong_tpu.analysis (the lint gates' new home).
 
-Gate 1 — no bare ``print(``: everything in the library reports through the
-leveled logger (utils/logger.py) or the metrics registry (obs/metrics.py) —
-stdout belongs to report surfaces only. Allowed:
+Until PR 6 this script owned three hand-rolled AST gates (bare prints,
+batcher-bypass ``engine.execute`` calls, WAL-less mutations). Those now
+live as plugins in ``wukong_tpu/analysis/obs_gates.py`` next to the rest
+of the project's gates; this shim keeps the CLI contract stable for CI
+and the existing tests:
 
-- ``runtime/console.py`` and ``runtime/monitor.py`` (the interactive
-  console and the rolling report are stdout surfaces by design)
-- calls lexically inside a function named ``main`` (CLI entry points:
-  datagen/lubm emit their JSON meta to stdout like any Unix tool)
+- ``python scripts/lint_obs.py [PKG_ROOT]`` exits 0/1 with one line per
+  violation, exactly as before;
+- ``violations(pkg_root)`` returns the legacy list-of-strings form;
+- the allowlists are re-exported so forks that extended them keep
+  working.
 
-Gate 2 — no direct ``engine.execute(`` under ``runtime/`` outside the
-allowlisted bypass sites: interactive dispatches must flow through
-``Proxy._serve_execute`` (the batcher entry point, runtime/batcher.py) so
-future code can't silently reopen a one-query-per-dispatch path next to the
-coalescer. The allowlist names the sites that ARE the serving machinery.
-
-Gate 3 — mutation durability: any function that calls ``insert_triples(``
-(the primary-store mutation entry) must route through the WAL append hook
-``maybe_wal_append(`` in the same top-level function, or be allowlisted.
-Acknowledged mutations that skip the WAL are silently lost on a crash —
-exactly the gap this gate keeps closed. The allowlist names derived-state
-writers (window stores rebuild from WAL-logged epochs) and the recovery
-replay itself (which applies records under WAL suppression).
-
-Run standalone (``python scripts/lint_obs.py``) or via the test suite
-(tests/test_obs.py::test_lint_obs_gate, tests/test_batcher.py). Exit code 1
-+ one line per violation when a gate fails.
+The full gate suite (lock discipline, drift gates, ...) runs via
+``python -m wukong_tpu.analysis`` — this shim runs only the three legacy
+gates, which are the ones that make sense on a bare package tree.
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
 
-ALLOWED_FILES = {
-    os.path.join("runtime", "console.py"),
-    os.path.join("runtime", "monitor.py"),
-}
-ALLOWED_FUNCS = {"main"}
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:  # standalone invocation from anywhere
+    sys.path.insert(0, _REPO_ROOT)
 
-# (runtime-relative file, enclosing function) pairs allowed to call
-# ``<obj>.execute(...)`` directly — the serving machinery itself
-EXECUTE_ALLOWLIST = {
-    ("proxy.py", "_serve_execute"),   # THE batcher entry / bypass site
-    ("proxy.py", "_run_repeats"),     # shape/capacity degradation re-runs
-    ("scheduler.py", "_engine_loop"),  # pool engines executing popped work
-    ("batcher.py", "_run_single"),    # per-query fallback of a fused group
-    ("batcher.py", "_run_fused"),     # the fused dispatch itself
-}
-
-# (package-relative file, top-level function) pairs allowed to call
-# ``insert_triples(`` without the WAL append hook
-WAL_ALLOWLIST = {
-    # the per-partition mutation primitive itself (hooked at batch level)
-    ("store/dynamic.py", "insert_triples"),
-    # private window store: derived state, rebuilt from WAL-logged epochs
-    ("stream/continuous.py", "_on_epoch_windowed"),
-    # recovery replay re-applies durable records under WAL suppression
-    # (boot) or onto a not-yet-promoted partition under the mutation lock
-    ("runtime/recovery.py", "_replay_wal"),
-    ("runtime/recovery.py", "_rebuild_shard_locked"),
-}
-
-
-class _PrintFinder(ast.NodeVisitor):
-    def __init__(self):
-        self.func_stack: list[str] = []
-        self.hits: list[int] = []  # line numbers of disallowed prints
-
-    def visit_FunctionDef(self, node):
-        self.func_stack.append(node.name)
-        self.generic_visit(node)
-        self.func_stack.pop()
-
-    visit_AsyncFunctionDef = visit_FunctionDef
-
-    def visit_Call(self, node):
-        if (isinstance(node.func, ast.Name) and node.func.id == "print"
-                and not (set(self.func_stack) & ALLOWED_FUNCS)):
-            self.hits.append(node.lineno)
-        self.generic_visit(node)
-
-
-class _MutationFinder(ast.NodeVisitor):
-    """Per TOP-LEVEL function: does it (or any nested def) call
-    ``insert_triples`` / the WAL hook ``maybe_wal_append``? Nested defs
-    attribute to their outermost function — the hook protects the whole
-    batch path, wherever the loop body lives."""
-
-    def __init__(self):
-        self.func_stack: list[str] = []
-        # top-level func -> (first insert lineno, saw_hook)
-        self.funcs: dict[str, list] = {}
-
-    def visit_FunctionDef(self, node):
-        self.func_stack.append(node.name)
-        self.generic_visit(node)
-        self.func_stack.pop()
-
-    visit_AsyncFunctionDef = visit_FunctionDef
-
-    def _name_of(self, func) -> str:
-        if isinstance(func, ast.Name):
-            return func.id
-        if isinstance(func, ast.Attribute):
-            return func.attr
-        return ""
-
-    def visit_Call(self, node):
-        name = self._name_of(node.func)
-        if name in ("insert_triples", "maybe_wal_append") and self.func_stack:
-            top = self.func_stack[0]
-            ent = self.funcs.setdefault(top, [None, False])
-            if name == "insert_triples" and ent[0] is None:
-                ent[0] = node.lineno
-            if name == "maybe_wal_append":
-                ent[1] = True
-        self.generic_visit(node)
-
-
-class _ExecuteFinder(ast.NodeVisitor):
-    """Direct ``<obj>.execute(...)`` calls with their enclosing function."""
-
-    def __init__(self):
-        self.func_stack: list[str] = []
-        self.hits: list[tuple[int, str]] = []  # (lineno, enclosing func)
-
-    def visit_FunctionDef(self, node):
-        self.func_stack.append(node.name)
-        self.generic_visit(node)
-        self.func_stack.pop()
-
-    visit_AsyncFunctionDef = visit_FunctionDef
-
-    def visit_Call(self, node):
-        if isinstance(node.func, ast.Attribute) and node.func.attr == "execute":
-            self.hits.append(
-                (node.lineno, self.func_stack[-1] if self.func_stack else ""))
-        self.generic_visit(node)
+from wukong_tpu.analysis.framework import run_analysis  # noqa: E402
+from wukong_tpu.analysis.obs_gates import (  # noqa: E402,F401 (re-exports)
+    ALLOWED_FILES,
+    ALLOWED_FUNCS,
+    EXECUTE_ALLOWLIST,
+    LEGACY_GATES,
+    WAL_ALLOWLIST,
+)
 
 
 def violations(pkg_root: str) -> list[str]:
-    out: list[str] = []
-    for dirpath, _dirs, files in os.walk(pkg_root):
-        for fn in sorted(files):
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fn)
-            rel = os.path.relpath(path, pkg_root)
-            with open(path) as f:
-                try:
-                    tree = ast.parse(f.read(), filename=path)
-                except SyntaxError as e:
-                    out.append(f"{rel}: syntax error: {e}")
-                    continue
-            if rel not in ALLOWED_FILES:
-                finder = _PrintFinder()
-                finder.visit(tree)
-                out.extend(f"{rel}:{ln}: bare print() in library code "
-                           "(use utils.logger or obs.metrics)"
-                           for ln in finder.hits)
-            if os.path.basename(dirpath) == "runtime":
-                ef = _ExecuteFinder()
-                ef.visit(tree)
-                out.extend(
-                    f"{rel}:{ln}: direct engine.execute() bypasses the "
-                    "batcher entry point (route through "
-                    "Proxy._serve_execute or extend EXECUTE_ALLOWLIST)"
-                    for ln, func in ef.hits
-                    if (fn, func) not in EXECUTE_ALLOWLIST)
-            mf = _MutationFinder()
-            mf.visit(tree)
-            rel_posix = rel.replace(os.sep, "/")
-            out.extend(
-                f"{rel}:{ln}: insert_triples() without the WAL append "
-                "hook — an acknowledged mutation this path commits is "
-                "lost on crash (call maybe_wal_append before mutating, "
-                "or extend WAL_ALLOWLIST for derived-state writers)"
-                for func, (ln, hooked) in sorted(mf.funcs.items())
-                if ln is not None and not hooked
-                and (rel_posix, func) not in WAL_ALLOWLIST)
+    """Legacy form: one ``path:line: message`` string per violation from
+    the three original gates (parse failures included, as before)."""
+    out = []
+    for v in run_analysis(pkg_root, plugins=list(LEGACY_GATES)):
+        out.append(f"{v.path}:{v.line}: {v.message}" if v.gate != "parse"
+                   else f"{v.path}: {v.message}")
     return out
 
 
 def main(argv=None) -> int:
     args = argv if argv is not None else sys.argv[1:]
-    root = args[0] if args else os.path.join(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "wukong_tpu")
+    root = args[0] if args else os.path.join(_REPO_ROOT, "wukong_tpu")
     bad = violations(root)
     for line in bad:
         print(line)

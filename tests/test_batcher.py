@@ -36,6 +36,23 @@ from wukong_tpu.utils.lru import LRUCache
 pytestmark = pytest.mark.batch
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _lockdep_checked():
+    """PR 6: the batch suite runs with the lockdep runtime checker on —
+    the batcher condition / group locks / pool lanes feed the
+    acquisition-order graph on every test. Teardown asserts zero order
+    cycles and zero declared-leaf inversions."""
+    from wukong_tpu.analysis import lockdep
+
+    lockdep.install(True)
+    yield
+    try:
+        assert lockdep.cycles() == [], lockdep.cycles()
+        assert lockdep.leaf_violations() == [], lockdep.leaf_violations()
+    finally:
+        lockdep.install(False)
+
+
 @pytest.fixture(scope="module")
 def world():
     triples, _ = generate_lubm(1, seed=42)

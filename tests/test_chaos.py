@@ -58,6 +58,25 @@ SELECT ?X WHERE {
 """
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _lockdep_checked():
+    """PR 6: the whole chaos suite runs with the lockdep runtime checker
+    enabled — every lock the suite's pools/batchers/WALs create is a
+    Debug wrapper feeding the acquisition-order graph, so every existing
+    concurrency test doubles as a lock-order regression test. Teardown
+    asserts the suite produced zero order cycles and zero declared-leaf
+    inversions."""
+    from wukong_tpu.analysis import lockdep
+
+    lockdep.install(True)
+    yield
+    try:
+        assert lockdep.cycles() == [], lockdep.cycles()
+        assert lockdep.leaf_violations() == [], lockdep.leaf_violations()
+    finally:
+        lockdep.install(False)
+
+
 @pytest.fixture(autouse=True)
 def _no_leaked_plan():
     faults.clear()
@@ -615,6 +634,55 @@ def test_shard_transients_are_retried_transparently(dist_world, monkeypatch):
     dist.execute(q)
     assert q.result.status_code == ErrorCode.SUCCESS
     assert q.result.complete is True
+
+
+def test_chain_dispatch_transient_retried_transparently(dist_world,
+                                                        monkeypatch):
+    """The ``dist.chain_dispatch`` fault site (fault-site coverage gap
+    closed by the analysis gate): a transient on the compiled-chain
+    dispatch is absorbed by retry_call and the reply is byte-identical to
+    an unfaulted run."""
+    from wukong_tpu.parallel.dist_engine import DistEngine
+
+    monkeypatch.setattr(Global, "retry_base_ms", 1)
+    monkeypatch.setattr(Global, "retry_max_ms", 2)
+    ss, stores, mesh = dist_world
+    dist = DistEngine(stores, ss, mesh)
+    q0 = _parse(ss, Q2HOP)
+    dist.execute(q0)  # unfaulted oracle
+    plan = FaultPlan([FaultSpec("dist.chain_dispatch", "transient",
+                                count=1)], seed=3)
+    faults.install(plan)
+    q = _parse(ss, Q2HOP)
+    dist.execute(q)
+    assert [h[0] for h in plan.history] == ["dist.chain_dispatch"]
+    assert q.result.status_code == ErrorCode.SUCCESS
+    assert q.result.complete is True
+    assert q.result.nrows == q0.result.nrows
+    import numpy as np
+
+    assert np.array_equal(np.asarray(q.result.table),
+                          np.asarray(q0.result.table))
+
+
+def test_chain_dispatch_exhaustion_is_structured(dist_world, monkeypatch):
+    """Persistent chain-dispatch transients exhaust the retry budget and
+    surface as the structured RETRY_EXHAUSTED reply status (the engine
+    contract: errors become the reply), never a raw TransientFault
+    escaping the engine."""
+    from wukong_tpu.parallel.dist_engine import DistEngine
+
+    monkeypatch.setattr(Global, "retry_base_ms", 1)
+    monkeypatch.setattr(Global, "retry_max_ms", 2)
+    ss, stores, mesh = dist_world
+    plan = FaultPlan([FaultSpec("dist.chain_dispatch", "transient")], seed=3)
+    faults.install(plan)
+    dist = DistEngine(stores, ss, mesh)
+    q = _parse(ss, Q2HOP)
+    dist.execute(q)  # must not raise
+    assert q.result.status_code == ErrorCode.RETRY_EXHAUSTED
+    # the retry layer really paid the full budget before giving up
+    assert len(plan.history) == Global.retry_max_attempts
 
 
 def test_shard_recovery_restores_complete_results(dist_world, monkeypatch):

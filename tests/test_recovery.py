@@ -54,6 +54,23 @@ from wukong_tpu.utils.errors import CheckpointCorrupt, ErrorCode
 
 pytestmark = pytest.mark.recovery
 
+
+@pytest.fixture(autouse=True, scope="module")
+def _lockdep_checked():
+    """PR 6: the recovery suite runs with the lockdep runtime checker on —
+    WAL/checkpoint/heal locking (incl. the mutation-lock ordering) is
+    regression-checked by every test here. Teardown asserts zero
+    order cycles and zero declared-leaf inversions."""
+    from wukong_tpu.analysis import lockdep
+
+    lockdep.install(True)
+    yield
+    try:
+        assert lockdep.cycles() == [], lockdep.cycles()
+        assert lockdep.leaf_violations() == [], lockdep.leaf_violations()
+    finally:
+        lockdep.install(False)
+
 QDEPT = """
 PREFIX rdf: <http://www.w3.org/1999/02/22-rdf-syntax-ns#>
 PREFIX ub: <http://swat.cse.lehigh.edu/onto/univ-bench.owl#>

@@ -1,0 +1,38 @@
+"""wukong-analyze: project-wide static analysis + runtime concurrency
+checking.
+
+Two halves share this package:
+
+- **Static gates** (:mod:`framework`, :mod:`obs_gates`, :mod:`guarded`,
+  :mod:`drift`): a plugin registry run by ``python -m wukong_tpu.analysis``
+  (``--json`` for machine-readable output) and by the tier-1 test
+  ``tests/test_analysis.py::test_repo_is_clean``. ``scripts/lint_obs.py``
+  survives as an exit-code-compatible shim over the three legacy gates.
+- **Runtime lockdep** (:mod:`lockdep`): ``DebugLock``/``DebugRLock``/
+  ``DebugCondition`` factories behind the ``debug_locks`` config knob,
+  recording the per-thread lock acquisition-order graph, reporting
+  order cycles (potential deadlocks) with both stacks, flagging
+  declared-leaf inversions, and exporting hold/contention histograms.
+
+Import cost discipline: runtime modules (scheduler, wal, batcher, ...)
+import only :mod:`lockdep`, which never pulls the AST machinery in.
+"""
+
+from __future__ import annotations
+
+__all__ = [
+    "AnalysisPlugin", "RepoContext", "SourceFile", "Violation",
+    "plugin_names", "register", "run_analysis",
+]
+
+
+def __getattr__(name):
+    # lazy re-export (PEP 562): the hot runtime modules import
+    # analysis.lockdep at startup, and resolving THIS package must not
+    # drag the ast/tokenize framework in with it — the static machinery
+    # loads only when a gate actually runs
+    if name in __all__:
+        from wukong_tpu.analysis import framework
+
+        return getattr(framework, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -139,6 +139,17 @@ class GlobalConfig:
     metrics_snapshot_s: int = 0
     metrics_snapshot_path: str = ""
 
+    # ---- concurrency checking (wukong_tpu/analysis/lockdep.py) ----
+    # lockdep-style runtime lock-order checker: locks created through the
+    # analysis.lockdep factories become Debug wrappers that record the
+    # per-thread acquisition-order graph, report order cycles (potential
+    # deadlocks) with both stacks, flag declared-leaf inversions, and
+    # export hold/contention histograms. OFF by default and zero-cost off:
+    # the factories return plain threading primitives, not wrappers.
+    # Consulted at lock CREATION time — flip it before building the
+    # objects under test (tests use analysis.lockdep.install()).
+    debug_locks: bool = False
+
     # ---- serving-path batching knobs (runtime/batcher.py; all mutable) ----
     # coalesce live same-template queries into fused dispatches. OFF by
     # default: the serving path is byte-for-byte unchanged unless enabled.

@@ -28,7 +28,7 @@ from wukong_tpu.obs.metrics import get_registry
 from wukong_tpu.utils.logger import log_info, log_warn
 
 _lock = threading.Lock()
-_server: "ThreadingHTTPServer | None" = None
+_server: "ThreadingHTTPServer | None" = None  # guarded by: _lock
 
 
 class _MetricsHandler(BaseHTTPRequestHandler):

@@ -115,8 +115,11 @@ class _Metric:
         self.kind = kind
         self.labelnames = tuple(labelnames)
         self.buckets = tuple(sorted(float(b) for b in buckets))
+        # deliberately a PLAIN lock, never a lockdep factory product: the
+        # lockdep checker publishes its own histograms through this
+        # registry, so tracking registry locks would recurse
         self._lock = threading.Lock()
-        self._children: dict[tuple, _Child] = {}
+        self._children: dict[tuple, _Child] = {}  # guarded by: _lock
         if not self.labelnames:
             self._default = self._child(())
         self._fn = None  # gauge callback (evaluated at export)
@@ -193,8 +196,8 @@ class MetricsRegistry:
     handles and ad-hoc lookups converge on the same series)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
-        self._metrics: dict[str, _Metric] = {}
+        self._lock = threading.Lock()  # plain: see _Metric._lock
+        self._metrics: dict[str, _Metric] = {}  # guarded by: _lock
 
     def _get_or_create(self, name: str, help: str, kind: str,
                        labels: tuple = (), buckets: tuple = ()) -> _Metric:

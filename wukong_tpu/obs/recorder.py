@@ -17,9 +17,9 @@ from __future__ import annotations
 
 import json
 import os
-import threading
 from collections import deque
 
+from wukong_tpu.analysis.lockdep import make_lock
 from wukong_tpu.config import Global
 from wukong_tpu.obs.metrics import get_registry
 from wukong_tpu.obs.trace import QueryTrace
@@ -34,10 +34,10 @@ DUMP_CODES = frozenset({ErrorCode.QUERY_TIMEOUT, ErrorCode.BUDGET_EXCEEDED,
 class FlightRecorder:
     def __init__(self, capacity: int | None = None):
         self.capacity = capacity
-        self._lock = threading.Lock()
+        self._lock = make_lock("obs.recorder")
         self._ring: deque[QueryTrace] = deque(
-            maxlen=capacity or max(int(Global.trace_ring), 1))
-        self.dumps: deque[tuple[str, QueryTrace]] = deque(maxlen=64)
+            maxlen=capacity or max(int(Global.trace_ring), 1))  # guarded by: _lock
+        self.dumps: deque[tuple[str, QueryTrace]] = deque(maxlen=64)  # guarded by: _lock
         reg = get_registry()
         self._m_recorded = reg.counter(
             "wukong_traces_recorded_total", "Completed query traces kept")

@@ -26,8 +26,14 @@ import itertools
 import threading
 from collections import defaultdict
 
+from wukong_tpu.analysis.lockdep import declare_leaf, make_lock
 from wukong_tpu.config import Global
 from wukong_tpu.utils.timer import get_usec
+
+# span-stack locks only ever guard list/dict appends — innermost by
+# construction (the resilience layer already fires its trace hooks outside
+# the breaker lock; lockdep now proves that stays true)
+declare_leaf("trace.spans")
 
 _tls = threading.local()
 _trace_seq = itertools.count(1)
@@ -90,9 +96,9 @@ class QueryTrace:
         self.t0_us = get_usec()
         self.t1_us: int | None = None
         self.status = "RUNNING"
-        self.spans: list[Span] = []
-        self._lock = threading.Lock()
-        self._stacks: dict[int, list[Span]] = defaultdict(list)
+        self.spans: list[Span] = []  # guarded by: _lock
+        self._lock = make_lock("trace.spans")
+        self._stacks: dict[int, list[Span]] = defaultdict(list)  # guarded by: _lock
 
     # ------------------------------------------------------------------
     def start_span(self, name: str, **attrs) -> Span:
@@ -148,7 +154,7 @@ class QueryTrace:
         """Aggregate span timings by name: the per-step time-breakdown
         section bench artifacts carry ({name: {count, total_us, max_us}})."""
         out: dict[str, dict] = {}
-        for sp in self.spans:
+        for sp in self.spans:  # unguarded: reporting surface — runs on finished traces (recorder/bench), after every writer ended
             d = out.setdefault(sp.name, {"count": 0, "total_us": 0, "max_us": 0})
             d["count"] += 1
             d["total_us"] += sp.dur_us
@@ -156,14 +162,14 @@ class QueryTrace:
         return out
 
     def event_names(self) -> list[str]:
-        return [n for sp in self.spans for (_t, n, _a) in sp.events]
+        return [n for sp in self.spans for (_t, n, _a) in sp.events]  # unguarded: reporting surface on finished traces
 
     def to_dict(self) -> dict:
         return {"trace_id": self.trace_id, "kind": self.kind, "qid": self.qid,
                 "status": self.status, "t0_us": self.t0_us,
                 "dur_us": self.dur_us,
                 **({"text": self.text} if self.text else {}),
-                "spans": [sp.to_dict() for sp in self.spans]}
+                "spans": [sp.to_dict() for sp in self.spans]}  # unguarded: reporting surface on finished traces
 
 
 # ---------------------------------------------------------------------------

@@ -55,20 +55,25 @@ class ShardedDeviceStore:
         from wukong_tpu.config import Global
         from wukong_tpu.runtime.resilience import CircuitBreaker
 
-        self.stores = stores
+        self.stores = stores  # lock-free: slot replacement (rebuild_shard) is a single atomic list-item store; readers see old or new, never torn
         self.mesh = mesh
         self.axis = axis
         self.D = len(stores)
         assert self.D == mesh.devices.size, "one partition per mesh device"
-        self._cache: dict = {}
-        self._index_cache: dict = {}
-        self.bytes_used = 0
-        self._seen_version = self.version()
+        # staging caches are lock-free by design: engines and the heal
+        # watcher race dict get/set/clear, every one an atomic CPython op.
+        # The worst interleaving re-stages a segment (idempotent, cached
+        # value identical) — taking a lock here would serialize every
+        # staged fetch behind the slowest staging
+        self._cache: dict = {}  # lock-free: atomic dict ops; losers of a staging race overwrite with an identical value
+        self._index_cache: dict = {}  # lock-free: atomic dict ops, same contract as _cache
+        self.bytes_used = 0  # lock-free: advisory accounting (HBM budget report), drift is bounded by one staging
+        self._seen_version = self.version()  # lock-free: single int store; a stale read just re-runs check_version
         # resilience: per-shard circuit breaker over host-side fetches, and
         # the set of shards whose data is currently missing from stagings
         # (the dist engine tags replies incomplete while it is non-empty)
         self.breaker = CircuitBreaker()
-        self.degraded_shards: set[int] = set()
+        self.degraded_shards: set[int] = set()  # lock-free: atomic set add/discard; a stale read only delays healing by one watcher sweep
         # fault tolerance: with replication_factor k > 1 each logical
         # shard's data is mirrored onto its k-1 successor hosts; a failed
         # primary fetch fails over to a replica instead of substituting an
@@ -77,8 +82,9 @@ class ShardedDeviceStore:
         k = (Global.replication_factor if replication_factor is None
              else replication_factor)
         self.replication_factor = max(1, min(int(k), self.D))
-        self.replicas: dict[int, list] = {}  # shard -> [(host, GStore)]
-        self.failover_shards: set[int] = set()
+        # shard -> [(host, GStore)]
+        self.replicas: dict[int, list] = {}  # lock-free: whole-dict replacement in refresh_replicas; readers iterate a snapshot reference
+        self.failover_shards: set[int] = set()  # lock-free: atomic set ops, same contract as degraded_shards
         if self.replication_factor > 1:
             self.refresh_replicas()
 

@@ -41,6 +41,7 @@ import threading
 
 import numpy as np
 
+from wukong_tpu.analysis.lockdep import make_condition, make_lock
 from wukong_tpu.config import Global
 from wukong_tpu.obs import activate, get_recorder, get_registry, maybe_start_trace
 from wukong_tpu.runtime.resilience import CircuitBreaker, mark_partial
@@ -341,7 +342,13 @@ class FusedGroup:
         self.batcher = batcher
         self.engine = engine  # preferred engine (the TPU path), or None
         self.reason = reason
-        self._noted = False  # in-flight accounting settled exactly once
+        # in-flight accounting settled exactly once; the flag needs its
+        # own lock because run()'s finally (engine thread) can race
+        # fail_all() from the scheduler's death handler or the flusher —
+        # an unserialized check-then-set double-decremented the batcher's
+        # _inflight count (found by the guarded-by gate)
+        self._note_lock = make_lock("batch.group")
+        self._noted = False  # guarded by: _note_lock
 
     # -- completion plumbing -------------------------------------------
     @staticmethod
@@ -349,9 +356,12 @@ class FusedGroup:
         m.event.set()
 
     def _note_once(self) -> None:
-        if not self._noted:
+        with self._note_lock:
+            if self._noted:
+                return
             self._noted = True
-            self.batcher._note_done()
+        # outside the group lock: _note_done takes the batcher condition
+        self.batcher._note_done()
 
     def fail_all(self, exc: BaseException) -> None:
         """Infrastructure failure (dead pool / engine-thread death): the
@@ -539,14 +549,14 @@ class QueryBatcher:
         self.tpu = tpu_engine
         self._pool = pool  # object, or zero-arg callable returning one/None
         self.breaker = CircuitBreaker()
-        self._lock = threading.Condition()
-        self._groups: dict = {}
+        self._lock = make_condition("batcher.groups")
+        self._groups: dict = {}  # guarded by: _lock
         # dispatches currently executing: the continuous-batching signal —
         # while one runs, arrivals accumulate; when idle, a lone query
         # flushes immediately instead of paying the window
-        self._inflight = 0
-        self._drain_now = False
-        self._stopped = False
+        self._inflight = 0  # guarded by: _lock
+        self._drain_now = False  # guarded by: _lock
+        self._stopped = False  # guarded by: _lock
         self._thread = threading.Thread(target=self._flusher, daemon=True,
                                         name="batcher-flush")
         self._thread.start()
@@ -554,7 +564,7 @@ class QueryBatcher:
     # ------------------------------------------------------------------
     def offer(self, q: SPARQLQuery) -> _Pending | None:
         """Admit a planned query; None means bypass (caller dispatches)."""
-        if self.cpu is None or self._stopped:
+        if self.cpu is None:
             return None
         dl = getattr(q, "deadline", None)
         if dl is not None:
@@ -579,6 +589,12 @@ class QueryBatcher:
         to_flush = None
         reason = "size"
         with self._lock:
+            # stop-check INSIDE the admit critical section: close() flips
+            # _stopped and drains _groups under this same lock, so an
+            # admit can never slip in after the final flush and strand
+            # its waiter (a separate pre-check left that window open)
+            if self._stopped:
+                return None
             grp = self._groups.get(key)
             if grp is None:
                 grp = self._groups[key] = _OpenGroup(

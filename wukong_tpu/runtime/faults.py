@@ -41,6 +41,25 @@ import time
 from dataclasses import dataclass, field
 
 
+# THE central fault-site registry: every ``faults.site("X")`` hook in the
+# package must use a name declared here, every name here must have a live
+# hook, and every name must be exercised by at least one test — all three
+# directions are enforced mechanically by the ``fault-sites`` analysis
+# gate (wukong_tpu/analysis/drift.py). Adding a site = add the hook, add
+# the name here, add a deterministic chaos test.
+KNOWN_FAULT_SITES = frozenset({
+    "dist.shard_fetch",    # per-shard host CSR fetch (sharded_store)
+    "dist.chain_dispatch",  # compiled-chain dispatch (dist_engine)
+    "hdfs.read",           # HDFS CLI invocations (loader/hdfs.py)
+    "pool.execute",        # per-query execution (runtime/scheduler.py)
+    "dynamic.insert",      # online batch insert (store/dynamic.py)
+    "stream.ingest",       # per-epoch commit (stream/ingest.py)
+    "wal.append",          # write-ahead-log append (store/wal.py)
+    "replica.fetch",       # failover replica fetch (sharded_store)
+    "checkpoint.write",    # checkpoint bundle write (runtime/recovery.py)
+})
+
+
 class TransientFault(Exception):
     """An injected transient infrastructure failure (retryable)."""
 

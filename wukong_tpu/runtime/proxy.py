@@ -17,6 +17,7 @@ import threading
 
 import numpy as np
 
+from wukong_tpu.analysis.lockdep import make_lock
 from wukong_tpu.config import Global
 from wukong_tpu.obs import (
     activate,
@@ -85,13 +86,13 @@ class Proxy:
         # the batcher itself starts lazily on the first batched dispatch
         self._parse_cache = LRUCache(Global.parse_cache_size)
         self._plan_cache = PlanCache(Global.plan_cache_size)
-        self._batcher: QueryBatcher | None = None
-        self._batcher_init_lock = threading.Lock()
+        self._batcher: QueryBatcher | None = None  # guarded by: _batcher_init_lock
+        self._batcher_init_lock = make_lock("proxy.batcher_init")
         # fault tolerance: the recovery manager (checkpoint/restore + shard
         # healing) starts lazily; its background threads launch here only
         # when the knobs ask for them (zero-cost when off)
-        self._recovery = None
-        self._recovery_init_lock = threading.Lock()
+        self._recovery = None  # guarded by: _recovery_init_lock
+        self._recovery_init_lock = make_lock("proxy.recovery_init")
         if (Global.checkpoint_interval_s > 0 and Global.checkpoint_dir) or (
                 dist_engine is not None and Global.replication_factor > 1):
             self.recovery().start()
@@ -317,14 +318,14 @@ class Proxy:
         """Lazily-started request coalescer. Groups ride the engine pool's
         batch lane when the pool is running, else they run inline on the
         batcher's flusher thread."""
-        if self._batcher is None:
+        if self._batcher is None:  # unguarded: double-checked fast path — an atomic reference read; construction is serialized below
             with self._batcher_init_lock:  # concurrent first dispatches
                 if self._batcher is None:  # must share ONE coalescer
                     cpu = self.cpu or (self.tpu.cpu
                                        if self.tpu is not None else None)
                     self._batcher = QueryBatcher(cpu, self.tpu,
                                                  pool=lambda: self._pool)
-        return self._batcher
+        return self._batcher  # unguarded: write-once reference, non-None past init
 
     def _serve_execute(self, q: SPARQLQuery, eng,
                        pinned: bool = False) -> SPARQLQuery:
@@ -494,7 +495,7 @@ class Proxy:
     def recovery(self):
         """Lazily-assembled RecoveryManager over this proxy's stores,
         stream context, and sharded store."""
-        if self._recovery is None:
+        if self._recovery is None:  # unguarded: double-checked fast path — an atomic reference read; construction is serialized below
             with self._recovery_init_lock:
                 if self._recovery is None:
                     from wukong_tpu.runtime.recovery import RecoveryManager
@@ -505,7 +506,7 @@ class Proxy:
                         sstore=getattr(self.dist, "sstore", None),
                         pool=lambda: self._pool,
                         on_change=self._on_store_change)
-        return self._recovery
+        return self._recovery  # unguarded: write-once reference, non-None past init
 
     def _on_store_change(self) -> None:
         """Restore/rebuild invalidation: exactly the dynamic-insert

@@ -42,6 +42,7 @@ import threading
 import time
 import zlib
 
+from wukong_tpu.analysis.lockdep import make_lock
 from wukong_tpu.config import Global
 from wukong_tpu.obs.metrics import get_registry
 from wukong_tpu.obs.trace import trace_event
@@ -123,15 +124,20 @@ class RecoveryManager:
         self._ckpt_dir_override = ckpt_dir
         self.pool = pool or (lambda: None)
         self.on_change = on_change
-        self._heal_attempts: dict[int, float] = {}
+        # heal bookkeeping is shared between the background watcher, the
+        # console/drill thread, and the pool engine running a RebuildJob —
+        # the claim (inflight check + backoff check + attempt stamp) must
+        # be one atomic step or two sweeps double-queue a shard's rebuild
+        self._heal_lock = make_lock("recovery.heal")
+        self._heal_attempts: dict[int, float] = {}  # guarded by: _heal_lock
         # shards with a rebuild queued/running on the pool's rebuild lane:
         # the lane drains only when every other lane is empty, so without
         # this the watcher would enqueue a duplicate job per sweep while
         # one waits out a busy pool
-        self._heal_inflight: set[int] = set()
-        self._lock = threading.Lock()
+        self._heal_inflight: set[int] = set()  # guarded by: _heal_lock
+        self._lock = make_lock("recovery.ckpt")
         self._stop = threading.Event()
-        self._threads: list[threading.Thread] = []
+        self._threads: list[threading.Thread] = []  # lock-free: start()/stop() are operator-thread only
 
     @property
     def stores(self) -> list:
@@ -423,26 +429,33 @@ class RecoveryManager:
         healed = []
         now = time.monotonic()
         for i in self.sick_shards():
-            if i in self._heal_inflight:
-                continue  # one queued/running rebuild per shard, ever
-            if not force and \
-                    now - self._heal_attempts.get(i, -1e18) < HEAL_BACKOFF_S:
-                continue
-            self._heal_attempts[i] = now
             pool = self.pool() if background else None
+            with self._heal_lock:
+                # the whole claim is one atomic step: inflight check,
+                # backoff check, attempt stamp, and (background mode) the
+                # inflight mark — a concurrent sweep sees either nothing
+                # or a fully-claimed shard, never a half-claim
+                if i in self._heal_inflight:
+                    continue  # one queued/running rebuild per shard, ever
+                if not force and now - self._heal_attempts.get(
+                        i, -1e18) < HEAL_BACKOFF_S:
+                    continue
+                self._heal_attempts[i] = now
+                if pool is not None:
+                    self._heal_inflight.add(i)
             if pool is not None:
-                self._heal_inflight.add(i)
-
                 def _job(i=i):
                     try:
                         self._rebuild_shard(i)
                     finally:
-                        self._heal_inflight.discard(i)
+                        with self._heal_lock:
+                            self._heal_inflight.discard(i)
 
                 job = RebuildJob(_job, label=f"shard-{i}")
                 if pool.submit(job, lane="rebuild") == -1 and job.done.is_set():
                     # dead pool settled it via fail_all without running
-                    self._heal_inflight.discard(i)
+                    with self._heal_lock:
+                        self._heal_inflight.discard(i)
             elif self._rebuild_shard(i):
                 healed.append(i)
         return healed

@@ -28,6 +28,7 @@ import random
 import threading
 import time
 
+from wukong_tpu.analysis.lockdep import declare_leaf, make_lock
 from wukong_tpu.config import Global
 from wukong_tpu.obs.metrics import get_registry
 from wukong_tpu.obs.trace import trace_event
@@ -47,6 +48,11 @@ _M_RETRIES = get_registry().counter(
 _M_BREAKER_TRIPS = get_registry().counter(
     "wukong_breaker_trips_total",
     "Circuit breaker open/reopen transitions", labels=("key",))
+
+# breaker state locks are innermost by design: holding one while calling
+# into any other locked subsystem (tracing, metrics push with tracked
+# locks, the WAL) is an ordering inversion lockdep flags
+declare_leaf("breaker.state")
 
 
 class Deadline:
@@ -223,14 +229,18 @@ class CircuitBreaker:
         self.cooldown_s = (Global.breaker_cooldown_ms
                            if cooldown_ms is None else cooldown_ms) / 1e3
         self._clock = clock
-        self._lock = threading.Lock()
+        # a declared lockdep LEAF: this class deliberately publishes its
+        # trace events / metrics OUTSIDE the lock ("hooks must not hold
+        # breaker state") — the checker now enforces that discipline
+        # instead of a comment merely requesting it
+        self._lock = make_lock("breaker.state")
         # key -> [consecutive_failures, opened_at | None, half_open_inflight]
-        self._st: dict = {}
+        self._st: dict = {}  # guarded by: _lock
         # key -> clock time of the most recent open/reopen (trip); survives
         # the breaker closing again, so operators can see flap history
-        self._last_trip: dict = {}
+        self._last_trip: dict = {}  # guarded by: _lock
 
-    def _slot(self, key):
+    def _slot(self, key):  # caller holds: _lock
         return self._st.setdefault(key, [0, None, False])
 
     def _state_of(self, slot, now: float) -> str:

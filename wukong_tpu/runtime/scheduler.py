@@ -28,6 +28,7 @@ import collections
 import threading
 import time
 
+from wukong_tpu.analysis.lockdep import make_lock
 from wukong_tpu.config import Global
 from wukong_tpu.obs.metrics import get_registry
 from wukong_tpu.utils.timer import get_usec
@@ -88,47 +89,52 @@ class EnginePool:
         """make_engine(tid) -> object with .execute(query) (one per thread,
         mirroring per-thread SPARQLEngine instances)."""
         self.n = num_engines or Global.num_engines
+        # per-engine run queues, each guarded by the matching element of
+        # `locks` (declared in analysis/guarded.py GUARDED_BY_REGISTRY —
+        # per-element guards have no single annotation line)
         self.queues = [collections.deque() for _ in range(self.n)]
-        self.locks = [threading.Lock() for _ in range(self.n)]
+        self.locks = [make_lock("pool.queue") for _ in range(self.n)]
         self._make_engine = make_engine
-        self._threads: list[threading.Thread | None] = [None] * self.n
+        self._threads: list[threading.Thread | None] = [None] * self.n  # lock-free: start/stop/respawn are operator-or-dying-thread only
         self._stop = threading.Event()
         self._pending = threading.Semaphore(0)
-        self._results: dict[int, object] = {}
-        self._results_lock = threading.Lock()
-        self._next_qid = 0
-        self._done = {}
-        self._completed = collections.deque()  # finished qids (poll() feed)
-        self._respawns = [0] * self.n
-        self._dead = [False] * self.n
+        self._results: dict[int, object] = {}  # guarded by: _results_lock
+        self._results_lock = make_lock("pool.results")
+        self._next_qid = 0  # guarded by: _results_lock
+        self._done = {}  # guarded by: _results_lock
+        # finished qids (poll() feed); append-before-set protocol relies
+        # on CPython deque append/popleft atomicity
+        self._completed = collections.deque()  # lock-free: atomic deque ops, see _fail()
+        self._respawns = [0] * self.n  # lock-free: per-tid slot, single writer (the engine thread / its respawner)
+        self._dead = [False] * self.n  # guarded by: _route_lock
         # serializes dead-state transitions against routing: submit's
         # dead-check + enqueue must not interleave with declare-dead's
         # drain, or a query lands in a queue nobody will ever pop
-        self._route_lock = threading.Lock()
-        self._busy_since = [0] * self.n  # usec; 0 = idle (health() surface)
-        self._inflight: list = [None] * self.n  # (qid, query) being executed
+        self._route_lock = make_lock("pool.route")
+        self._busy_since = [0] * self.n  # lock-free: per-tid slot, single writer; health() reads a snapshot
+        self._inflight: list = [None] * self.n  # lock-free: per-tid slot, single writer (engine thread; death handler runs after it stopped)
         # stream lane: shared low-priority queue for standing-query work
-        self.stream_queue = collections.deque()
-        self._stream_lock = threading.Lock()
+        self.stream_queue = collections.deque()  # guarded by: _stream_lock
+        self._stream_lock = make_lock("pool.stream")
         # batch lane: coalesced serving-path groups (runtime/batcher.py).
         # A group is ONE item — work stealing cannot split it — popped
         # right after the engine's own queue (batched queries are
         # interactive traffic, unlike the stream lane's background work).
         # Groups deliver results through their members' futures, so items
         # here are fire-and-forget for the pool's result bookkeeping.
-        self.batch_queue = collections.deque()
-        self._batch_lock = threading.Lock()
+        self.batch_queue = collections.deque()  # guarded by: _batch_lock
+        self._batch_lock = make_lock("pool.batch")
         # rebuild lane: background shard-rebuild jobs (runtime/recovery.py
         # RebuildJob), drained only when every other lane is empty —
         # healing soaks idle capacity, never displaces serving traffic.
         # Items share the batch lane's fire-and-forget contract
         # (run(engine) + fail_all(exc)).
-        self.rebuild_queue = collections.deque()
-        self._rebuild_lock = threading.Lock()
+        self.rebuild_queue = collections.deque()  # guarded by: _rebuild_lock
+        self._rebuild_lock = make_lock("pool.rebuild")
         # stream-lane qids are reserved for wait(): poll() skips them, so
         # an open-loop poll() consumer (the emulator) sharing this pool
         # can't steal the stream context's completions
-        self._stream_qids: set = set()
+        self._stream_qids: set = set()  # guarded by: _results_lock
         _POOLS.add(self)  # feeds the wukong_pool_queue_depth gauge
 
     # ------------------------------------------------------------------
@@ -163,7 +169,7 @@ class EnginePool:
         be preempted safely); dead engines are routed around."""
         now = get_usec()
         return {
-            tid: {"alive": not self._dead[tid],
+            tid: {"alive": not self._dead[tid],  # unguarded: report-only snapshot; a stale bool here only ages the health report by one call
                   "respawns": self._respawns[tid],
                   "busy_us": (now - b) if (b := self._busy_since[tid]) else 0}
             for tid in range(self.n)}
@@ -281,7 +287,7 @@ class EnginePool:
         if lane in ("batch", "rebuild"):
             _M_SUBMITTED.labels(lane=lane).inc()
             lock = self._batch_lock if lane == "batch" else self._rebuild_lock
-            queue = self.batch_queue if lane == "batch" else self.rebuild_queue
+            queue = self.batch_queue if lane == "batch" else self.rebuild_queue  # unguarded: binds the deque reference only (immutable attr); mutated below under `lock`
             with self._route_lock:
                 if all(self._dead[k] for k in range(self.n)):
                     fail = getattr(query, "fail_all", None)
@@ -332,7 +338,12 @@ class EnginePool:
     def wait(self, qid: int, timeout: float | None = None):
         """Returns the engine's result, or raises TimeoutError (the result
         stays claimable by a later wait — no stranded entries)."""
-        if not self._done[qid].wait(timeout):
+        # capture the event under the lock: the bare `self._done[qid]`
+        # read raced concurrent dict mutation (found by the guarded-by
+        # analysis gate when _done was annotated)
+        with self._results_lock:
+            ev = self._done[qid]
+        if not ev.wait(timeout):
             raise TimeoutError(f"query {qid} still running")
         with self._results_lock:
             self._done.pop(qid, None)

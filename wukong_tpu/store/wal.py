@@ -35,6 +35,7 @@ from __future__ import annotations
 import os
 import pickle
 import struct
+import sys
 import threading
 import time
 import zlib
@@ -42,6 +43,12 @@ from dataclasses import dataclass
 
 import numpy as np
 
+from wukong_tpu.analysis.lockdep import (
+    declare_leaf,
+    make_lock,
+    make_rlock,
+    register_global_lock,
+)
 from wukong_tpu.config import Global
 from wukong_tpu.utils.errors import CheckpointCorrupt
 from wukong_tpu.utils.logger import log_warn
@@ -50,6 +57,12 @@ MAGIC = b"WKWAL1\n"
 _HDR = struct.Struct("<II")  # body length, crc32(body)
 
 SYNC_POLICIES = ("none", "interval", "always")
+
+# the per-WAL segment lock is a declared LEAF: code holding it only does
+# file I/O and never calls back out into locked subsystems — acquiring any
+# tracked lock (the mutation lock above all) while holding it is a
+# lock-order inversion the lockdep checker flags
+declare_leaf("wal.segment")
 
 
 @dataclass
@@ -93,15 +106,16 @@ class WriteAheadLog:
                                         else float(sync_interval_s))
         self.segment_bytes = (Global.wal_segment_mb * (1 << 20)
                               if segment_bytes is None else int(segment_bytes))
-        self._lock = threading.Lock()
-        self._fh = None
-        self._fh_bytes = 0
-        self._last_fsync = 0.0
-        self._suppress = 0  # recovery replay must not re-log what it applies
+        self._lock = make_lock("wal.segment")
+        self._fh = None  # guarded by: _lock
+        self._fh_bytes = 0  # guarded by: _lock
+        self._last_fsync = 0.0  # guarded by: _lock
+        # recovery replay must not re-log what it applies
+        self._suppress = 0  # guarded by: _lock
         (self._m_appends, self._m_bytes, self._m_fsyncs,
          self._m_replayed) = _metrics()
         os.makedirs(dirname, exist_ok=True)
-        self.next_seq = self._scan_next_seq()
+        self.next_seq = self._scan_next_seq()  # guarded by: _lock
 
     # ------------------------------------------------------------------
     def _segments(self) -> list[tuple[int, str]]:
@@ -181,7 +195,7 @@ class WriteAheadLog:
     # ------------------------------------------------------------------
     @property
     def suppressed(self) -> bool:
-        return self._suppress > 0
+        return self._suppress > 0  # unguarded: atomic int read; replay raises the count before any hook it replays through can observe it
 
     def suppress(self):
         """Context manager: WAL hooks become no-ops inside (recovery replay
@@ -200,7 +214,7 @@ class WriteAheadLog:
 
         return _S()
 
-    def _open_segment(self, first_seq: int) -> None:
+    def _open_segment(self, first_seq: int) -> None:  # caller holds: _lock
         if self._fh is not None:
             self._fh.close()
         path = os.path.join(self.dir, f"wal-{first_seq:016d}.log")
@@ -320,8 +334,8 @@ class WriteAheadLog:
 # process-wide accessor + the mutation hook
 # ---------------------------------------------------------------------------
 
-_state: dict = {"wal": None, "dir": None}
-_state_lock = threading.Lock()
+_state: dict = {"wal": None, "dir": None}  # guarded by: _state_lock
+_state_lock = make_lock("wal.state")
 
 # serializes batch mutations (dynamic insert fan-out, stream epoch commits)
 # against checkpoint serialization: a checkpoint that captures its WAL
@@ -329,11 +343,22 @@ _state_lock = threading.Lock()
 # would half-contain the racing epoch yet record it as covered. Batch-level
 # and reentrant (a commit's nested per-store inserts run on the same
 # thread), so the uncontended cost is one lock op per BATCH, not per row.
-_commit_lock = threading.RLock()
+_commit_lock = make_rlock("wal.mutation_lock")
 
 
 def mutation_lock() -> "threading.RLock":
+    """THE coarse outer commit lock. Always reach it through this accessor
+    (never bind ``_commit_lock`` at import): lockdep's ``install()``
+    rebuilds the module-level object when the chaos/recovery/batch suites
+    flip the process into checked mode."""
     return _commit_lock
+
+
+# these two are created at import time — before any test can flip the
+# debug_locks knob — so they register for lockdep.install() rebinding
+register_global_lock(sys.modules[__name__], "_state_lock", "wal.state")
+register_global_lock(sys.modules[__name__], "_commit_lock",
+                     "wal.mutation_lock", kind="rlock")
 
 
 def active_wal() -> WriteAheadLog | None:

@@ -179,12 +179,15 @@ class StreamIngestor:
 
     def __init__(self, stores: list, continuous=None, monitor=None,
                  dedup: bool = True):
-        self.stores = list(stores)
+        self.stores = list(stores)  # lock-free: whole-list rebinding (recovery heals swap it atomically); commit iterates a snapshot reference
         self.continuous = continuous
         self.monitor = monitor
         self.dedup = bool(dedup)
-        self.epoch = 0
-        self.log: deque = deque(maxlen=EPOCH_LOG_WINDOW)  # recent epochs
+        # the epoch counter advances only inside the WAL mutation lock —
+        # the same lock that makes a commit atomic w.r.t. checkpoints
+        self.epoch = 0  # guarded by: mutation_lock()
+        # recent epochs (bounded)
+        self.log: deque = deque(maxlen=EPOCH_LOG_WINDOW)  # lock-free: atomic deque append; report readers tolerate a stale tail
 
     def commit_epoch(self, triples: np.ndarray, ts: float | None = None
                      ) -> EpochRecord:
@@ -255,7 +258,10 @@ class StreamIngestor:
         _M_EVAL.observe(rec.eval_us)
         _M_LAG.observe(rec.lag_us)
         if trace is not None:
-            trace.qid = self.epoch  # epoch number IS the stream qid
+            # rec.epoch, not self.epoch: past the mutation lock a racing
+            # commit may already have advanced the shared counter (found
+            # by the guarded-by gate)
+            trace.qid = rec.epoch  # epoch number IS the stream qid
             get_recorder().on_complete(trace)
         self.log.append(rec)
         return rec

@@ -14,10 +14,14 @@ the operations are dict moves, so the lock is never contended for long.
 
 from __future__ import annotations
 
-import threading
 from collections import OrderedDict
 
+from wukong_tpu.analysis.lockdep import declare_leaf, make_lock
+
 _MISS = object()
+
+# LRU locks guard pure dict moves — innermost by construction
+declare_leaf("lru")
 
 
 class LRUCache:
@@ -25,11 +29,11 @@ class LRUCache:
 
     def __init__(self, maxsize: int = 1024):
         self.maxsize = max(int(maxsize), 1)
-        self._d: OrderedDict = OrderedDict()
-        self._lock = threading.Lock()
-        self.hits = 0
-        self.misses = 0
-        self.evictions = 0
+        self._d: OrderedDict = OrderedDict()  # guarded by: _lock
+        self._lock = make_lock("lru")
+        self.hits = 0  # guarded by: _lock
+        self.misses = 0  # guarded by: _lock
+        self.evictions = 0  # guarded by: _lock
 
     def get(self, key, default=None):
         with self._lock:
