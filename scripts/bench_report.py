@@ -1,0 +1,197 @@
+#!/usr/bin/env python3
+"""Consolidate the BENCH_*.json artifacts into one perf-trajectory table.
+
+Seven PRs of benchmarks left ~30 ``BENCH_*.json`` files whose history is
+only legible by diffing git. This script makes the trajectory a first-class
+artifact:
+
+- ``BENCH_TRAJECTORY.md`` — one markdown table per benchmark *series*
+  (``BENCH_DIST_r03/r04/r05`` is the series ``DIST`` at rungs 3..5; files
+  without a ``_rNN`` suffix are single-point series), newest rung last,
+  with the delta vs the prior rung.
+- ``BENCH_TRAJECTORY.json`` — the same, machine-readable (the next PR's
+  rung appends instead of re-deriving).
+- ``--check`` — exit non-zero when any series' newest rung regressed
+  >``--threshold`` percent (default 20) against the prior rung. Direction
+  comes from the unit: latency-like units (us/ms/ns) regress upward,
+  rate-like units (q/s, rows/s) regress downward; unit-less series are
+  reported but never fail the check.
+
+Artifact shapes handled: headline files ({metric, value, unit, ...}),
+bench_loop wrapper files ({parsed: {…headline…}, tail, rc}), and composite
+files without a scalar headline (listed, excluded from the check).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+RUNG_RE = re.compile(r"^(BENCH(?:_[A-Za-z0-9]+)*?)_r(\d+)$")
+
+LOWER_BETTER = ("us", "ms", "ns", "sec")
+HIGHER_BETTER = ("q/s", "qps", "/s")
+
+
+def _direction(unit: str) -> int:
+    """-1 lower-better, +1 higher-better, 0 unknown (never checked)."""
+    u = (unit or "").lower()
+    if any(tok in u for tok in HIGHER_BETTER):
+        return 1
+    if any(u.startswith(tok) or f"{tok}/" in u or u == tok
+           for tok in LOWER_BETTER):
+        return -1
+    return 0
+
+
+def _headline(d: dict) -> dict | None:
+    """{value, unit, metric} from one artifact, unwrapping bench_loop
+    wrappers; None when the file has no scalar headline."""
+    if isinstance(d.get("parsed"), dict):
+        d = d["parsed"]
+    if isinstance(d.get("value"), (int, float)):
+        return {"value": float(d["value"]), "unit": d.get("unit", ""),
+                "metric": str(d.get("metric", ""))[:160]}
+    # serving artifact: qps headline without a value field
+    for key in ("batched_qps", "qps", "thpt_qps"):
+        if isinstance(d.get(key), (int, float)):
+            return {"value": float(d[key]), "unit": "q/s", "metric": key}
+    return None
+
+
+def collect(bench_dir: str) -> dict:
+    """series -> {unit, metric, points: [{rung, file, value}] newest last,
+    plus a list of headline-less composite files}."""
+    series: dict[str, dict] = {}
+    composites = []
+    for path in sorted(glob.glob(os.path.join(bench_dir, "BENCH_*.json"))):
+        base = os.path.splitext(os.path.basename(path))[0]
+        if base == "BENCH_TRAJECTORY":
+            continue  # this script's own output is not an input
+
+        try:
+            d = json.load(open(path))
+        except (OSError, json.JSONDecodeError) as e:
+            composites.append({"file": base, "note": f"unreadable: {e}"})
+            continue
+        m = RUNG_RE.match(base)
+        name, rung = (m.group(1), int(m.group(2))) if m else (base, None)
+        head = _headline(d)
+        if head is None:
+            composites.append({"file": base,
+                               "note": "no scalar headline (composite)"})
+            continue
+        s = series.setdefault(name, {"unit": head["unit"],
+                                     "metric": head["metric"], "points": []})
+        s["points"].append({"rung": rung, "file": base,
+                            "value": head["value"]})
+    for s in series.values():
+        s["points"].sort(key=lambda p: (p["rung"] is not None, p["rung"]))
+        s["direction"] = _direction(s["unit"])
+    return {"series": series, "composites": composites}
+
+
+def _delta_pct(prev: float, cur: float) -> float | None:
+    if prev == 0:
+        return None
+    return (cur - prev) / prev * 100.0
+
+
+def check(data: dict, threshold: float) -> list[str]:
+    """Regression messages for series whose newest rung is worse than the
+    prior rung by more than ``threshold`` percent."""
+    bad = []
+    for name, s in sorted(data["series"].items()):
+        pts, d = s["points"], s["direction"]
+        if len(pts) < 2 or d == 0:
+            continue
+        prev, cur = pts[-2], pts[-1]
+        pct = _delta_pct(prev["value"], cur["value"])
+        if pct is None:
+            continue
+        regressed = pct > threshold if d < 0 else pct < -threshold
+        if regressed:
+            bad.append(
+                f"{name}: {prev['file']} -> {cur['file']} moved "
+                f"{prev['value']:,.1f} -> {cur['value']:,.1f} {s['unit']} "
+                f"({pct:+.1f}%, allowed ±{threshold:.0f}% "
+                f"{'lower' if d < 0 else 'higher'}-is-better)")
+    return bad
+
+
+def render_md(data: dict, threshold: float) -> str:
+    lines = [
+        "# BENCH trajectory",
+        "",
+        "Consolidated view of every `BENCH_*.json` headline across PR "
+        "rungs (`scripts/bench_report.py`; regenerate after adding a "
+        "rung). `Δ%` compares each rung to the prior one; `--check` "
+        f"fails the build past ±{threshold:.0f}% in the unit's regression "
+        "direction.",
+        "",
+        "| series | unit | rung trail (oldest → newest) | latest | Δ% vs prior |",
+        "|---|---|---|---:|---:|",
+    ]
+    for name, s in sorted(data["series"].items()):
+        pts = s["points"]
+        trail = " → ".join(
+            (f"r{p['rung']:02d}:" if p["rung"] is not None else "")
+            + f"{p['value']:,.1f}" for p in pts)
+        latest = pts[-1]
+        pct = (_delta_pct(pts[-2]["value"], latest["value"])
+               if len(pts) >= 2 else None)
+        arrow = "" if s["direction"] == 0 or pct is None else (
+            " ⚠" if (pct > threshold if s["direction"] < 0
+                     else pct < -threshold) else "")
+        lines.append(
+            f"| {name} | {s['unit'] or '-'} | {trail} "
+            f"| {latest['value']:,.1f} "
+            f"| {'-' if pct is None else f'{pct:+.1f}%'}{arrow} |")
+    if data["composites"]:
+        lines += ["", "Composite artifacts (no scalar headline, not "
+                      "trended): "
+                  + ", ".join(f"`{c['file']}`" for c in data["composites"])]
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--dir", default=None,
+                    help="directory holding BENCH_*.json (default: repo root)")
+    ap.add_argument("--out", default=None,
+                    help="output directory (default: same as --dir)")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 on a >threshold%% regression vs the "
+                         "newest prior rung")
+    ap.add_argument("--threshold", type=float, default=20.0)
+    ns = ap.parse_args(argv)
+    bench_dir = ns.dir or os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    out_dir = ns.out or bench_dir
+    data = collect(bench_dir)
+    data["threshold_pct"] = ns.threshold
+    md = render_md(data, ns.threshold)
+    with open(os.path.join(out_dir, "BENCH_TRAJECTORY.md"), "w") as f:
+        f.write(md)
+    with open(os.path.join(out_dir, "BENCH_TRAJECTORY.json"), "w") as f:
+        json.dump(data, f, indent=1, sort_keys=True)
+    print(f"bench-report: {len(data['series'])} series, "
+          f"{len(data['composites'])} composites -> "
+          f"{os.path.join(out_dir, 'BENCH_TRAJECTORY.md')}")
+    if ns.check:
+        bad = check(data, ns.threshold)
+        for b in bad:
+            print(f"REGRESSION: {b}", file=sys.stderr)
+        if bad:
+            return 1
+        print(f"bench-report: no series regressed past "
+              f"{ns.threshold:.0f}% vs its prior rung")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

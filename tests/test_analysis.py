@@ -47,7 +47,8 @@ def test_repo_is_clean():
 def test_plugin_registry():
     assert set(plugin_names()) == {
         "no-bare-print", "batcher-route", "wal-hook", "guarded-by",
-        "fault-sites", "config-readme", "metrics-readme", "error-taxonomy"}
+        "fault-sites", "config-readme", "metrics-readme", "error-taxonomy",
+        "heat-telemetry"}
 
 
 def test_unknown_plugin_rejected():

@@ -187,7 +187,12 @@ def plugin_names() -> list[str]:
 def _load_builtin_plugins() -> None:
     # import for the registration side effect; lazy so lockdep (runtime
     # checker, imported by hot modules) never drags the AST gates in
-    from wukong_tpu.analysis import drift, guarded, obs_gates  # noqa: F401
+    from wukong_tpu.analysis import (  # noqa: F401
+        drift,
+        guarded,
+        obs_gates,
+        telemetry,
+    )
 
 
 def run_analysis(pkg_root: str | None = None, *, plugins=None,

@@ -139,6 +139,37 @@ class GlobalConfig:
     metrics_snapshot_s: int = 0
     metrics_snapshot_path: str = ""
 
+    # ---- introspection & heat telemetry (obs/profile.py, obs/heat.py) ----
+    # per-shard heat accounting: every sharded-store fetch (primary /
+    # failover / degraded) charges fetch count, rows, bytes, and latency
+    # into per-shard counters (EWMA + histogram), exported as the
+    # wukong_shard_heat_* metrics and the /top report. The charge rides the
+    # slow host-side fetch path (never per row), so on is the default.
+    enable_heat: bool = True
+    # per-shard latency / arrival samples kept for the heat CDFs
+    heat_window: int = 512
+    # latency attribution + regression sentinel: decompose each TRACED
+    # query's latency into queue/parse/plan/execute/fetch components,
+    # keep a rolling per-template baseline, and auto-dump the trace when a
+    # query regresses (component share shift or p95 drift). Needs
+    # enable_tracing for samples; off by default like tracing itself.
+    enable_attribution: bool = False
+    # rolling per-template baseline window (samples kept per template)
+    attribution_window: int = 256
+    # samples a template needs before the sentinel may flag it
+    attribution_min_samples: int = 32
+    # regression trip wires: a component's share of total latency moving
+    # by more than this many percentage points vs the baseline mean, or a
+    # query slower than baseline p95 by more than this percent
+    attribution_share_drift_pct: int = 25
+    attribution_p95_drift_pct: int = 100
+    # after a trip, a template's sentinel re-arms only after this many
+    # seconds: one anomaly = one dumped trace, not a log storm when a
+    # noisy template keeps wobbling around its own p95
+    attribution_cooldown_s: int = 30
+    # rows shown per section in the /top report and the `top` console verb
+    top_k: int = 8
+
     # ---- concurrency checking (wukong_tpu/analysis/lockdep.py) ----
     # lockdep-style runtime lock-order checker: locks created through the
     # analysis.lockdep factories become Debug wrappers that record the

@@ -4,9 +4,11 @@ ROADMAP follow-up (e) to the observability layer: render_prometheus() was
 scrape-*able* but nothing fronted it. This module adds:
 
 - :func:`maybe_start_metrics_http` — a stdlib ``http.server`` daemon thread
-  serving ``GET /metrics`` (Prometheus text exposition) and
-  ``GET /metrics.json`` (the JSON snapshot), gated on the ``metrics_port``
-  config knob (0 = off, the default). Idempotent per process.
+  serving ``GET /metrics`` (Prometheus text exposition), ``GET
+  /metrics.json`` (the JSON snapshot), and ``GET /top`` / ``/top.json``
+  (the shard/template/lane heat report, like ``top(1)`` — obs/profile.py
+  ``render_top``), gated on the ``metrics_port`` config knob (0 = off, the
+  default). Idempotent per process.
 - :class:`MetricsSnapshotter` — a daemon thread that writes the registry's
   JSON snapshot to a file every ``interval_s`` seconds (atomic
   tmp-then-rename), for the emulator's long soaks where scraping is
@@ -33,13 +35,32 @@ _server: "ThreadingHTTPServer | None" = None  # guarded by: _lock
 
 class _MetricsHandler(BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802 (stdlib handler naming)
-        path = self.path.split("?", 1)[0]
+        path, _, query = self.path.partition("?")
         if path in ("/metrics", "/"):
             body = get_registry().render_prometheus().encode()
             ctype = "text/plain; version=0.0.4; charset=utf-8"
         elif path == "/metrics.json":
             body = json.dumps(get_registry().snapshot(), indent=1).encode()
             ctype = "application/json"
+        elif path in ("/top", "/top.json"):
+            # top(1) for shards / templates / lanes (obs/profile.py); ?k=N
+            # widens or narrows every section
+            from wukong_tpu.obs.profile import render_top
+
+            k = None
+            for part in query.split("&"):
+                if part.startswith("k="):
+                    try:
+                        k = max(int(part[2:]), 1)
+                    except ValueError:
+                        pass
+            text, js = render_top(k)
+            if path.endswith(".json"):
+                body = json.dumps(js, indent=1).encode()
+                ctype = "application/json"
+            else:
+                body = text.encode()
+                ctype = "text/plain; charset=utf-8"
         elif path == "/healthz":
             body, ctype = b"ok\n", "text/plain"
         else:
@@ -81,7 +102,7 @@ def maybe_start_metrics_http(port: int | None = None):
         t.start()
         _server = srv
         log_info(f"metrics http endpoint on :{srv.server_address[1]} "
-                 "(/metrics, /metrics.json)")
+                 "(/metrics, /metrics.json, /top)")
         return srv
 
 

@@ -75,6 +75,13 @@ class FlightRecorder:
         if reason is not None:
             self._dump(trace, reason)
 
+    def dump(self, trace: QueryTrace, reason: str) -> None:
+        """Force-dump one trace (the latency-attribution regression
+        sentinel's entry: an anomalous query auto-dumps its trace with
+        reason ``LATENCY_REGRESSION`` even though its reply code and
+        duration look ordinary)."""
+        self._dump(trace, reason)
+
     def _dump(self, trace: QueryTrace, reason: str) -> None:
         with self._lock:
             self.dumps.append((reason, trace))

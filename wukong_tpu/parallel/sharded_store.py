@@ -195,15 +195,22 @@ class ShardedDeviceStore:
         return out, ok
 
     def _fetch_shard_impl(self, i: int, fn, what: str):
+        from wukong_tpu.obs.heat import maybe_charge
         from wukong_tpu.runtime import faults
         from wukong_tpu.runtime.resilience import retry_call
         from wukong_tpu.utils.errors import RetryExhausted, ShardUnavailable
         from wukong_tpu.utils.logger import log_warn
+        from wukong_tpu.utils.timer import get_usec
 
         def attempt():
             faults.site("dist.shard_fetch", shard=i)
             return fn(self.stores[i])
 
+        # heat accounting (obs/heat.py): every fetch outcome charges this
+        # shard's counters — fetch kind, payload rows/bytes, wall latency —
+        # the access-heat histogram ROADMAP item 3's migration decisions
+        # start from. One charge per staging, on the slow host path.
+        t0 = get_usec()
         try:
             out = retry_call(attempt, site=f"dist.shard_fetch[{i}]",
                              retry_on=(faults.TransientFault,),
@@ -215,6 +222,7 @@ class ShardedDeviceStore:
             # and stop touching the shard). With replication, fail over.
             got = self._fetch_failover(i, fn, what)
             if got is not None:
+                maybe_charge(i, "failover", got[0], get_usec() - t0)
                 return got[0], True
             code = e.code.name if isinstance(e, (ShardUnavailable,
                                                  RetryExhausted)) else str(e)
@@ -222,9 +230,11 @@ class ShardedDeviceStore:
                      "replica answered; substituting an empty shard — "
                      "results will be flagged incomplete")
             self._mark_degraded(i)
+            maybe_charge(i, "degraded", None, get_usec() - t0)
             return None, False
         self.degraded_shards.discard(i)
         self.failover_shards.discard(i)
+        maybe_charge(i, "primary", out, get_usec() - t0)
         return out, True
 
     def _fetch_failover(self, i: int, fn, what: str):

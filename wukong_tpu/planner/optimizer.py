@@ -419,20 +419,15 @@ class Planner:
                       empty=empty, exact=state.exact)
 
     # ------------------------------------------------------------------
-    def estimate_chain(self, patterns: list) -> list | None:
-        """Per-step output-row estimates for an ALREADY-ORDERED pattern list
-        (the plan the engine will execute).
-
-        Returns [rows_after_step_k for k in range(len(patterns))], or None if
-        the chain shape cannot be walked. This is the joint-type-table model
-        of _estimate_step applied to a fixed order — the engine uses it to
-        size device binding-table capacities tightly instead of compounding
-        per-step fanout safety margins (each 2x over-provision doubles every
-        kernel's cost: kernels pay for capacity, not live rows)."""
+    def _walk_chain(self, patterns: list) -> list | None:
+        """Step-by-step _State list for an ALREADY-ORDERED pattern list (the
+        plan the engine will execute), or None when the chain shape cannot
+        be walked. Shared by estimate_chain (capacity sizing) and
+        explain_steps (EXPLAIN estimate capture) so the cardinality model
+        never drifts between the two consumers."""
         if not patterns:
             return None
         p0 = patterns[0]
-        ests: list[float] = []
         state = None
         if p0.predicate == TYPE_ID and is_tpid(p0.subject) and p0.object < 0:
             # engine-form type-index start: (T, rdf:type, IN, ?X)
@@ -450,14 +445,46 @@ class Planner:
                                             p0.direction))
         if state is None:
             return None
-        ests.append(state.rows)
+        states = [state]
         for p in patterns[1:]:
             nxt = self._estimate_step(state, p, pre_oriented=True)
             if nxt is None:
                 return None
             state = nxt
-            ests.append(state.rows)
-        return ests
+            states.append(state)
+        return states
+
+    def estimate_chain(self, patterns: list) -> list | None:
+        """Per-step output-row estimates for an already-ordered pattern list.
+
+        Returns [rows_after_step_k for k in range(len(patterns))], or None if
+        the chain shape cannot be walked. This is the joint-type-table model
+        of _estimate_step applied to a fixed order — the engine uses it to
+        size device binding-table capacities tightly instead of compounding
+        per-step fanout safety margins (each 2x over-provision doubles every
+        kernel's cost: kernels pay for capacity, not live rows)."""
+        states = self._walk_chain(patterns)
+        return None if states is None else [st.rows for st in states]
+
+    def explain_steps(self, patterns: list) -> list | None:
+        """EXPLAIN estimate capture: one record per plan step with the
+        estimated output cardinality and the cost model's per-step charge
+        (the quantities EXPLAIN ANALYZE joins actual rows/wall-time against,
+        keyed on step index). Returns None when the plan shape cannot be
+        walked — the EXPLAIN surface then renders the plan without
+        estimates rather than inventing numbers."""
+        states = self._walk_chain(patterns)
+        if states is None:
+            return None
+        out = []
+        prev_cost = 0.0
+        for st in states:
+            out.append({"est_rows": float(st.rows),
+                        "est_cost": float(st.cost - prev_cost),
+                        "est_cost_cum": float(st.cost),
+                        "est_empty": bool(st.empty)})
+            prev_cost = st.cost
+        return out
 
     def _orient(self, state: _State, p: Pattern) -> Pattern:
         s_var_b = p.subject < 0 and p.subject in state.vars

@@ -479,6 +479,7 @@ class FusedGroup:
             if m.trace is not None:
                 m.trace.event("batch.dispatch", group=gid, size=B,
                               reason=self.reason)
+        t0 = get_usec()
         if ftrace is None:
             eng.execute(fq, from_proxy=False)
         else:
@@ -488,6 +489,17 @@ class FusedGroup:
                                  reason=self.reason, members=member_tids):
                     eng.execute(fq, from_proxy=False)
             get_recorder().on_complete(ftrace, fq.result.status_code)
+        # latency attribution (obs/profile.py): a member's execution
+        # happened inside THIS fused dispatch, not on its own trace —
+        # stamp the dispatch span's duration on every member so
+        # decompose() can attribute the member's execute component
+        # through its FusedGroup (works whether or not the group's own
+        # trace was sampled)
+        dispatch_us = get_usec() - t0
+        for m in live:
+            if m.trace is not None:
+                m.trace.event("batch.settled", group=gid,
+                              dispatch_us=dispatch_us)
         return fq
 
     def _scatter(self, fq: SPARQLQuery, live: list) -> None:

@@ -34,6 +34,15 @@ trace [-q <qid|id>] [-n <k>] [-o <file>]
                              flight recorder: list recent traces, print one
                              query's span tree by qid/trace id, or export
                              Chrome trace JSON (open in ui.perfetto.dev)
+explain <-f <file> | -q <text>> [-p <plan>]
+                             EXPLAIN: plan tree + per-step cost/cardinality
+                             estimates (no execution)
+analyze <-f <file> | -q <text>> [-d cpu|tpu|dist] [-j]
+                             EXPLAIN ANALYZE: execute under a forced trace,
+                             join estimated vs actual per-step rows / wall
+                             time / fetches + latency decomposition
+top [-k <n>] [-j]            hot shards / templates / lanes (like top(1);
+                             also served at GET /top on the metrics port)
 metrics [-j]                 dump the metrics registry (Prometheus text, -j JSON)
 checkpoint                   write one atomic checkpoint (partitions + stream
                              state) to checkpoint_dir; truncates covered WAL
@@ -87,6 +96,10 @@ class Console:
                 self._stat(rest, load=False)
             elif cmd == "trace":
                 self._trace(rest)
+            elif cmd in ("explain", "analyze"):
+                self._explain(rest, analyze=cmd == "analyze")
+            elif cmd == "top":
+                self._top(rest)
             elif cmd == "metrics":
                 self._metrics(rest)
             elif cmd == "checkpoint":
@@ -230,6 +243,55 @@ class Console:
             print(f"({len(rec.dumps)} auto-dumped: "
                   + ", ".join(f"{r}:{t.trace_id}"
                               for r, t in list(rec.dumps)[-8:]) + ")")
+
+    def _explain(self, rest, analyze: bool) -> None:
+        """explain / analyze: the EXPLAIN (ANALYZE) surface over
+        Proxy.explain_query (obs/profile.py)."""
+        import json
+
+        prog = "analyze" if analyze else "explain"
+        ap = argparse.ArgumentParser(prog=prog)
+        ap.add_argument("-f", default=None, help="query file")
+        ap.add_argument("-q", default=None, help="inline query text")
+        ap.add_argument("-d", default=None, choices=["cpu", "tpu", "dist"])
+        ap.add_argument("-p", default=None, help="user plan file (EXPLAIN)")
+        ap.add_argument("-j", action="store_true",
+                        help="print the structured JSON report")
+        ns = ap.parse_args(rest)
+        if (ns.f is None) == (ns.q is None):
+            log_error(f"usage: {prog} <-f <file> | -q <text>>")
+            return
+        try:
+            text = open(ns.f).read() if ns.f else ns.q
+            plan = open(ns.p).read() if ns.p else None
+        except OSError as e:  # a typo'd path must not kill the REPL
+            log_error(f"cannot read file: {e}")
+            return
+        report = self.proxy.explain_query(text, analyze=analyze,
+                                          device=ns.d, plan_text=plan)
+        if ns.j:
+            print(json.dumps({k: v for k, v in report.items()
+                              if k != "rendered"},
+                             indent=1, sort_keys=True, default=str))
+        else:
+            print(report["rendered"])
+
+    def _top(self, rest) -> None:
+        """top: hot shards / templates / lanes (the /top endpoint's body)."""
+        from wukong_tpu.obs.profile import render_top
+
+        ap = argparse.ArgumentParser(prog="top")
+        ap.add_argument("-k", type=int, default=None,
+                        help="rows per section (default: the top_k knob)")
+        ap.add_argument("-j", action="store_true", help="JSON output")
+        ns = ap.parse_args(rest)
+        text, js = render_top(ns.k)
+        if ns.j:
+            import json
+
+            print(json.dumps(js, indent=1, sort_keys=True, default=str))
+        else:
+            print(text, end="")
 
     def _recover(self, rest) -> None:
         """recover: boot-style checkpoint+WAL restore. recover -d <shard>:

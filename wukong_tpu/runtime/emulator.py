@@ -494,6 +494,66 @@ class Emulator:
                 "p50_us": int(p50), "p99_us": int(p99)}
 
     # ------------------------------------------------------------------
+    # hot-spot heat scenario (ROADMAP item 3 acceptance fixture)
+    # ------------------------------------------------------------------
+    def run_hotspot(self, n_ops: int = 1500, zipf_a: float = 1.6,
+                    seed: int = 0, sstore=None) -> dict:
+        """Skewed-workload heat scenario: drive ``n_ops`` host-side shard
+        fetches whose shard choice follows a Zipf(``zipf_a``) law rotated
+        onto a seeded hot shard, through the sharded store's normal
+        resilience fetch path (so every access charges the heat plane the
+        way live stagings do). Proves the telemetry the elastic-migration
+        tentpole consumes: the heat report must rank the hot shard first,
+        and the per-shard load-rate CDFs must separate hot from cold.
+        Returns {hot, ranked, separation, report} — ``separation`` is the
+        hot shard's p50 access rate over the hottest cold shard's.
+        """
+        from wukong_tpu.obs.heat import get_heat
+
+        sstore = sstore if sstore is not None else getattr(
+            self.proxy.dist, "sstore", None)
+        if sstore is None:
+            raise WukongError(ErrorCode.UNSUPPORTED_SHAPE,
+                              "the hot-spot scenario needs a sharded store "
+                              "(--dist)")
+        heat = get_heat()
+        heat.reset()  # the scenario's ranking starts from a clean slate
+        rng = np.random.default_rng(seed)
+        D = sstore.D
+        hot = int(rng.integers(0, D))
+        # Zipf weights over a rotation starting at the hot shard: rank-0
+        # mass lands on `hot`, the tail spreads over the cold shards
+        w = 1.0 / np.power(np.arange(1, D + 1, dtype=np.float64), zipf_a)
+        w /= w.sum()
+        order = [(hot + j) % D for j in range(D)]
+
+        def read_partition(g):
+            # a real host-side read with a measurable payload: the
+            # partition's largest index list (what an index-origin staging
+            # fetches), falling back to an empty array
+            best = max(((k, v) for k, v in g.index.items() if len(v)),
+                       key=lambda kv: len(kv[1]), default=None)
+            return (np.asarray(best[1]) if best is not None
+                    else np.empty(0, np.int64))
+
+        draws = rng.choice(D, size=int(n_ops), p=w)
+        for r in draws:
+            sstore._fetch_shard(order[int(r)], read_partition, "hotspot")
+        report = self.monitor.heat_report(k=D)
+        ranked = [r["shard"] for r in report["ranked"]]
+        hot_rate = report["shards"][hot]["load_rate_cdf"].get(0.5, 0.0)
+        cold_rates = [d["load_rate_cdf"].get(0.5, 0.0)
+                      for s, d in report["shards"].items() if s != hot]
+        separation = (hot_rate / max(cold_rates)
+                      if cold_rates and max(cold_rates) > 0 else float("inf"))
+        log_info(f"hotspot: shard {hot} drew "
+                 f"{report['shards'][hot]['share']:.0%} of {n_ops} fetches; "
+                 f"ranked={ranked[:4]}..., load-rate separation "
+                 f"{separation:.1f}x")
+        return {"hot": hot, "ranked": ranked,
+                "separation": separation, "report": report}
+
+    # ------------------------------------------------------------------
     # kill-and-recover drill (fault-tolerance fire drill)
     # ------------------------------------------------------------------
     def run_drill(self, shard: int = 1, texts: list | None = None,

@@ -14,6 +14,12 @@ MetricsRegistry (``wukong_query_latency_us`` histogram) and attached
 breakers export a pull gauge (``wukong_breaker_open``) — the Monitor's
 private vectors keep feeding the CDF prints, the registry feeds the
 Prometheus/JSON exporters.
+
+Heat telemetry (PR 7): the Monitor also aggregates the sharded store's
+per-shard heat charges (obs/heat.py) into per-shard load CDFs and a top-K
+hot-shard report — ``heat_report()`` / ``shard_load_cdfs()`` are the
+placement inputs ROADMAP item 3's migration planner consumes, and the
+rolling throughput report prints the hot-shard line.
 """
 
 from __future__ import annotations
@@ -152,6 +158,8 @@ class Monitor:
             self._last_stream_triples = self.stream.triples
             for line in self.breaker_report():
                 log_info(line)
+            for line in self.heat_lines(k=3):
+                log_info(line)
             self._last_print = now
             self._last_cnt = self.cnt
 
@@ -224,6 +232,34 @@ class Monitor:
                 line += f" (last trip {s['last_trip_age_s']:.1f}s ago)"
             lines.append(line)
         return lines
+
+    # -- per-shard heat (obs/heat.py; PR 7 telemetry plane) ----------------
+    def heat_report(self, k: int | None = None) -> dict:
+        """The aggregated per-shard heat view: load CDFs, latency CDFs,
+        and the top-K hot-shard ranking — the placement inputs ROADMAP
+        item 3's migration planner consumes. Aggregation lives on the
+        process-wide accountant (every sharded store charges into it);
+        the Monitor is its reporting surface."""
+        from wukong_tpu.obs.heat import get_heat
+
+        return get_heat().report(k)
+
+    def shard_load_cdfs(self) -> dict[int, dict]:
+        """shard -> load-rate CDF (instantaneous fetches/s percentiles)."""
+        rep = self.heat_report(k=None)
+        return {s: d["load_rate_cdf"] for s, d in rep["shards"].items()}
+
+    def heat_lines(self, k: int = 3) -> list[str]:
+        """Rolling-report lines: the top-k hot shards, only when any fetch
+        has been charged (quiet on single-host runs)."""
+        rep = self.heat_report(k)
+        if not rep["ranked"]:
+            return []
+        parts = []
+        for r in rep["ranked"]:
+            parts.append(f"{r['shard']}:{r['fetches']} ({r['share']:.0%}"
+                         f", ewma {r['ewma_us']:,.0f}us)")
+        return [f"Heat[top{k}]: " + "  ".join(parts)]
 
     # -- CDF (monitor.hpp print_cdf) ---------------------------------------
     def cdf(self, qtype: int | None = None,

@@ -232,6 +232,7 @@ class Proxy:
         self._m_queries.labels(status=status.name).inc()
         if trace is not None:
             self.recorder.on_complete(trace, status)
+            self._attribute(trace, q, text)
             log_info(f"trace {trace.trace_id} (qid {trace.qid}) recorded: "
                      f"{len(trace.spans)} spans, {trace.dur_us:,}us")
         if q.result.status_code != ErrorCode.SUCCESS:
@@ -384,7 +385,46 @@ class Proxy:
         self._m_queries.labels(status=q.result.status_code.name).inc()
         if trace is not None:
             self.recorder.on_complete(trace, q.result.status_code)
+            self._attribute(trace, q, text)
         return q
+
+    # ------------------------------------------------------------------
+    # introspection (obs/profile.py): EXPLAIN / EXPLAIN ANALYZE + the
+    # latency-attribution regression sentinel
+    # ------------------------------------------------------------------
+    def explain_query(self, text: str, analyze: bool = False,
+                      device: str | None = None,
+                      plan_text: str | None = None) -> dict:
+        """EXPLAIN: parse + plan and render the plan tree with the
+        planner's per-step cost/cardinality estimates. EXPLAIN ANALYZE:
+        additionally execute under a forced trace and join actual per-step
+        rows/wall-time/fetches against the estimates, plus the end-to-end
+        latency decomposition. Returns structured JSON; ``rendered`` holds
+        the table (console verbs ``explain`` / ``analyze``)."""
+        from wukong_tpu.obs.profile import explain_query
+
+        return explain_query(self, text, analyze=analyze, device=device,
+                             plan_text=plan_text)
+
+    def _attribute(self, trace, q: SPARQLQuery, text: str) -> None:
+        """Reply-side latency attribution: fold the finished trace into
+        its template's rolling baseline; the sentinel auto-dumps the trace
+        on a regression. One knob check when attribution is off."""
+        if not Global.enable_attribution:
+            return
+        from wukong_tpu.obs.profile import get_attributor, template_key
+
+        verdict = get_attributor().observe(
+            trace, template_key(q, text),
+            example=" ".join(text.split())[:120])
+        if verdict is not None:
+            log_error(
+                f"latency regression ({verdict['reason']}): template "
+                f"{verdict['template']} {verdict['total_us']:,}us vs "
+                f"baseline p95 {verdict['baseline_p95_us']:,}us, worst "
+                f"component {verdict['component']} "
+                f"{verdict['share_drift_pts']:+.1f}pts — trace "
+                f"{trace.trace_id} dumped")
 
     def print_result(self, q: SPARQLQuery, rows: int) -> None:
         """Render rows through the string server (proxy.hpp:247-294)."""

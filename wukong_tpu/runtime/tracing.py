@@ -1,14 +1,13 @@
-"""DEPRECATED: absorbed into :mod:`wukong_tpu.obs` (PR 3, observability).
+"""RETIRED (PR 7): the deprecation shim from PR 3 is gone.
 
-``StepTrace`` now lives in ``wukong_tpu.obs.trace`` and ``device_trace`` in
-``wukong_tpu.obs.export``; the full replacement for what this module stubbed
-out is the per-query :class:`wukong_tpu.obs.QueryTrace` + flight recorder.
-This shim keeps old imports working one more release.
+``StepTrace`` lives in :mod:`wukong_tpu.obs.trace` and ``device_trace`` in
+:mod:`wukong_tpu.obs.export`; the full replacement is the per-query
+:class:`wukong_tpu.obs.QueryTrace` + flight recorder. The shim carried old
+imports for one release; no in-repo importer remains, so importing this
+module is now a hard, explanatory error (tests pin the message).
 """
 
-from __future__ import annotations
-
-from wukong_tpu.obs.export import device_trace  # noqa: F401
-from wukong_tpu.obs.trace import StepTrace  # noqa: F401
-
-__all__ = ["StepTrace", "device_trace"]
+raise ImportError(
+    "wukong_tpu.runtime.tracing was retired: import StepTrace from "
+    "wukong_tpu.obs.trace and device_trace from wukong_tpu.obs.export "
+    "(or use wukong_tpu.obs.QueryTrace for per-query tracing)")
