@@ -612,6 +612,140 @@ def serve_main(device_ok: bool) -> None:
     }, "BENCH_SERVE.json")
 
 
+def serve_mixed_main(device_ok: bool) -> None:
+    """`bench.py --serve-mixed`: closed-loop MIXED light+heavy serving
+    throughput (weighted LUBM light template + index-origin heavy
+    queries). Baseline = the PR 4 posture (light batching on, heavy lane
+    OFF: index-origin queries run one-at-a-time); after = the heavy lane
+    fusing index-origin traffic into sliced device dispatches. Artifact:
+    BENCH_SERVE_MIXED.json (picked up by scripts/bench_report.py)."""
+    import numpy as np
+
+    from wukong_tpu.config import Global
+    from wukong_tpu.engine.cpu import CPUEngine
+    from wukong_tpu.engine.tpu import TPUEngine
+    from wukong_tpu.loader.lubm import UB
+    from wukong_tpu.planner.optimizer import Planner
+    from wukong_tpu.runtime.emulator import Emulator
+    from wukong_tpu.runtime.proxy import Proxy
+    from wukong_tpu.types import OUT
+
+    scale = int(os.environ.get("WUKONG_BENCH_SCALE", "0")) or 1
+    g, ss, stats = _ensure_world(scale)
+    proxy = Proxy(g, ss, cpu_engine=CPUEngine(g, ss),
+                  tpu_engine=TPUEngine(g, ss, stats=stats),
+                  planner=Planner(stats))
+    if os.environ.get("WUKONG_SERVE_HOST") == "1":
+        Global.enable_tpu = False
+    # the mix: the --serve-batched light template (const-start 1-hop)
+    # plus index-origin 3-hop heavies at WUKONG_MIX_HEAVY_SHARE of
+    # arrivals (default 30%) — the "mixed production traffic" shape
+    # ROADMAP item 1 names, where unfused heavy queries collapse
+    # throughput back toward the unbatched ceiling
+    pid = ss.str2id(f"<{UB}advisor>")
+    anchors = np.asarray(g.get_index(pid, OUT))
+    texts = [f"SELECT ?s WHERE {{ ?s <{UB}advisor> "
+             f"{ss.id2str(int(a))} . }}" for a in anchors[:512]]
+    heavy_texts = [
+        ("SELECT ?x ?y ?z WHERE { ?x "
+         "<http://www.w3.org/1999/02/22-rdf-syntax-ns#type> "
+         f"<{UB}UndergraduateStudent> . ?x <{UB}takesCourse> ?y . "
+         f"?x <{UB}memberOf> ?z . }}"),
+        ("SELECT ?x ?y ?z WHERE { ?x "
+         "<http://www.w3.org/1999/02/22-rdf-syntax-ns#type> "
+         f"<{UB}UndergraduateStudent> . ?x <{UB}takesCourse> ?y . "
+         f"?x <{UB}advisor> ?z . }}"),
+    ]
+    heavy_share = float(os.environ.get("WUKONG_MIX_HEAVY_SHARE", "0.3"))
+    all_texts = texts + heavy_texts
+    classes = [0] * len(texts) + [1] * len(heavy_texts)
+    weights = ([(1.0 - heavy_share) / len(texts)] * len(texts)
+               + [heavy_share / len(heavy_texts)] * len(heavy_texts))
+    dur = float(os.environ.get("WUKONG_SERVE_DURATION", "10"))
+    # more clients than --serve-batched: the heavy lane's win IS the
+    # collapsing of concurrent heavy waiters, which needs concurrency
+    clients = int(os.environ.get("WUKONG_SERVE_CLIENTS", "24"))
+    emu = Emulator(proxy)
+    # the heavy lane NEEDS the pool: without one, fused heavy dispatches
+    # run inline on the batcher's flusher thread and serialize the light
+    # groups behind them — the exact starvation the scheduler's weighted
+    # heavy lane exists to prevent
+    proxy.engine_pool()
+    for t in texts[:8] + heavy_texts:  # warm caches + jit shapes
+        proxy.serve_query(t, blind=True)
+    # precompile the fused heavy dispatch shapes (single + split) before
+    # the measurement window — steady state, the PR 4 measurement posture
+    import copy as _copy
+
+    for ht in heavy_texts:
+        hq = proxy._parse_text(ht)
+        proxy._plan_prepared(hq, True, None)
+        b = proxy.heavy_index_batch(hq)
+        proxy.tpu.execute_batch_index(hq, b, slice_mode=True)
+        S = min(int(Global.heavy_split_max), Global.num_engines)
+        if S > 1:
+            for k in range(S):
+                hk = _copy.deepcopy(hq)
+                hk.mt_factor, hk.mt_tid = S, k
+                proxy.tpu.execute_batch_index(hk, b, slice_mode=True)
+
+    def run() -> dict:
+        return emu.run_serving(all_texts, duration_s=dur, warmup_s=1.0,
+                               clients=clients, seed=1, weights=weights,
+                               classes=classes)
+
+    # baseline: light batching on, heavy one-at-a-time (the pre-heavy-lane
+    # serving path on the same mix)
+    Global.enable_batching = True
+    Global.heavy_lane = False
+    base = run()
+    # after: the heavy lane fuses index-origin traffic
+    Global.heavy_lane = True
+    on = run()
+    Global.enable_batching = False
+    speedup = round(on["qps"] / base["qps"], 2) if base["qps"] else None
+    from wukong_tpu.obs import get_registry
+
+    snap = get_registry().snapshot()
+    heavy_metrics = {
+        name: [{**s["labels"], "value": s["value"]}
+               for s in snap.get(name, {}).get("series", [])]
+        for name in ("wukong_batch_heavy_dispatch_total",
+                     "wukong_batch_heavy_fused_total",
+                     "wukong_batch_heavy_slices_total",
+                     "wukong_batch_heavy_fallback_total",
+                     "wukong_lane_routed_total")}
+    from wukong_tpu.obs.metrics import snapshot_histogram_mean
+
+    occ = snapshot_histogram_mean(snap, "wukong_batch_heavy_occupancy")
+    mean_occ = round(occ, 2) if occ is not None else None
+    _emit_final({
+        "metric": f"LUBM-{scale} MIXED light+heavy serving throughput, "
+                  f"{clients} clients x {dur:.0f}s closed loop "
+                  f"({heavy_share:.0%} index-origin heavy; heavy lane "
+                  "vs unbatched-heavy baseline)",
+        "value": on["qps"],
+        "unit": "q/s",
+        "mixed_qps": on["qps"],
+        "unbatched_heavy_qps": base["qps"],
+        "speedup": speedup,
+        "backend": "tpu" if device_ok else "cpu",
+        "detail": {
+            "baseline": base, "heavy_lane": on,
+            "knobs": {"batch_window_us": Global.batch_window_us,
+                      "batch_max_size": Global.batch_max_size,
+                      "heavy_batch_max": Global.heavy_batch_max,
+                      "heavy_split_threshold": Global.heavy_split_threshold,
+                      "heavy_lane_pct": Global.heavy_lane_pct,
+                      "heavy_share": heavy_share,
+                      "clients": clients, "scale": scale},
+            "mean_heavy_occupancy": mean_occ,
+            "heavy_metrics": heavy_metrics,
+            "dataset": DATASET_NOTES["lubm"],
+        },
+    }, "BENCH_SERVE_MIXED.json")
+
+
 def watdiv_main(device_ok: bool) -> None:
     """`bench.py --watdiv`: S1-S7/F1-F5 star/snowflake templates, batched
     (BASELINE.json configs[3] — no published reference number for this
@@ -1759,6 +1893,9 @@ def main():
         return
     if "--serve-batched" in sys.argv:
         serve_main(device_ok)
+        return
+    if "--serve-mixed" in sys.argv:
+        serve_mixed_main(device_ok)
         return
     if "--emu" in sys.argv:
         emu_main(device_ok)

@@ -56,8 +56,9 @@ def _headline(d: dict) -> dict | None:
     if isinstance(d.get("value"), (int, float)):
         return {"value": float(d["value"]), "unit": d.get("unit", ""),
                 "metric": str(d.get("metric", ""))[:160]}
-    # serving artifact: qps headline without a value field
-    for key in ("batched_qps", "qps", "thpt_qps"):
+    # serving artifact: qps headline without a value field (mixed_qps:
+    # the --serve-mixed light+heavy closed loop, BENCH_SERVE_MIXED.json)
+    for key in ("batched_qps", "mixed_qps", "qps", "thpt_qps"):
         if isinstance(d.get(key), (int, float)):
             return {"value": float(d[key]), "unit": "q/s", "metric": key}
     return None

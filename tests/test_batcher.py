@@ -296,13 +296,16 @@ def test_device_pin_bypasses_batcher(world, monkeypatch):
     assert offered == []  # pinned: never entered the batcher
 
 
-def test_incompatible_shapes_bypass(world):
+def test_incompatible_shapes_bypass(world, monkeypatch):
     proxy = world["proxy"]
     bt = proxy.batcher()
-    # index-origin query: no const start -> must bypass untouched
+    # index-origin query: no const start -> not LIGHT-batchable. Since the
+    # heavy lane (PR 8) it fuses as the heavy class instead of bypassing,
+    # so the shape-bypass exemplar is a NON-BLIND index query (the sliced
+    # heavy dispatch returns counts, not tables)
     q = _planned(proxy, "SELECT ?x WHERE { ?x "
                  "<http://www.w3.org/1999/02/22-rdf-syntax-ns#type> "
-                 f"<{UB}FullProfessor> . }}")
+                 f"<{UB}FullProfessor> . }}", blind=False)
     assert not batchable(q)
     before = _counter("wukong_batch_bypass_total", reason="shape")
     assert bt.offer(q) is None

@@ -140,5 +140,15 @@ def test_console_accepts_hdfs_uri(fake_hdfs, tmp_path):
 
     cfg = tmp_path / "config"
     cfg.write_text("global_enable_tpu 0\n")
-    assert console_main([str(cfg), "hdfs://fake/data",
-                         "-c", "store-stat"]) == 0
+    from wukong_tpu.config import Global
+
+    # console_main loads the config into the process-wide Global
+    # singleton — restore the knob it flips, or every later test module
+    # in a one-shot run sees enable_tpu off (the heavy-lane batcher
+    # admission was the first to notice)
+    prev = Global.enable_tpu
+    try:
+        assert console_main([str(cfg), "hdfs://fake/data",
+                             "-c", "store-stat"]) == 0
+    finally:
+        Global.enable_tpu = prev

@@ -34,15 +34,27 @@ ALLOWED_FILES = {
 }
 ALLOWED_FUNCS = {"main"}
 
-# (runtime-relative file, enclosing function) pairs allowed to call
-# ``<obj>.execute(...)`` directly — the serving machinery itself
+# (runtime-relative file, enclosing function) pairs allowed to call an
+# engine dispatch entry point (``.execute(`` or any ``.execute_batch*(``)
+# directly — the serving machinery itself. PR 8 widened the gate from
+# ``execute`` alone to every batch dispatch attr, so the heavy lane's
+# ``execute_batch_index`` cannot silently grow one-off call sites either.
 EXECUTE_ALLOWLIST = {
     ("proxy.py", "_serve_execute"),   # THE batcher entry / bypass site
     ("proxy.py", "_run_repeats"),     # shape/capacity degradation re-runs
     ("scheduler.py", "_engine_loop"),  # pool engines executing popped work
     ("batcher.py", "_run_single"),    # per-query fallback of a fused group
     ("batcher.py", "_run_fused"),     # the fused dispatch itself
+    ("batcher.py", "_run_slice"),     # the heavy lane's sliced dispatch
+    ("emulator.py", "run"),           # device-class precompile warmup
+    ("emulator.py", "_device_batch"),  # compiled-batch emulator flights
 }
+
+#: engine attrs the batcher-route gate treats as dispatch entry points
+DISPATCH_ATTRS = frozenset({
+    "execute", "execute_batch", "execute_batch_many", "execute_batch_mixed",
+    "execute_batch_index", "execute_batch_index_many",
+})
 
 # (package-relative file, top-level function) pairs allowed to call
 # ``insert_triples(`` without the WAL append hook
@@ -83,14 +95,16 @@ class _PrintFinder(_FuncStackVisitor):
 
 
 class _ExecuteFinder(_FuncStackVisitor):
-    """Direct ``<obj>.execute(...)`` calls with their enclosing function."""
+    """Direct engine-dispatch calls (``<obj>.execute(...)`` and the
+    ``.execute_batch*`` family) with their enclosing function."""
 
     def __init__(self):
         super().__init__()
         self.hits: list[tuple[int, str]] = []  # (lineno, enclosing func)
 
     def visit_Call(self, node):
-        if isinstance(node.func, ast.Attribute) and node.func.attr == "execute":
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in DISPATCH_ATTRS:
             self.hits.append(
                 (node.lineno, self.func_stack[-1] if self.func_stack else ""))
         self.generic_visit(node)

@@ -198,6 +198,36 @@ class GlobalConfig:
     parse_cache_size: int = 512
     plan_cache_size: int = 512
 
+    # ---- heavy-lane serving knobs (runtime/batcher.py heavy path; all
+    # mutable). Index-origin (wide-table) queries are the serving path's
+    # second fusable class: identical heavy templates coalesce into ONE
+    # sliced device dispatch (execute_batch_index) whose per-slice counts
+    # settle every waiter, and oversized dispatches split across pool
+    # engines by slice range with a gather barrier. ----
+    # admit index-origin templates into the batcher's heavy lane (only
+    # meaningful with enable_batching on; heavy fusion needs blind mode
+    # and a device engine)
+    heavy_lane: bool = True
+    # ceiling on the per-dispatch slice count suggest_index_batch may pick
+    # (the emulator's old ad-hoc min(.., 64) cap, now config)
+    heavy_batch_max: int = 64
+    # index lists at least this long split their fused dispatch across
+    # pool engines by slice range (gather barrier reassembles counts).
+    # Per-dispatch fixed cost is ~10ms on this container, so small scans
+    # LOSE total CPU by splitting — only genuinely big index lists
+    # (at-scale datasets) should fan out
+    heavy_split_threshold: int = 100000
+    # maximum split parts per fused heavy dispatch
+    heavy_split_max: int = 4
+    # weighted heavy lane: at most this percent of pool engines may
+    # execute heavy dispatches concurrently (min 1), so fused heavy work
+    # can never starve interactive light traffic
+    heavy_lane_pct: int = 50
+    # plan-time lane routing (planner estimate_chain peak): a template
+    # whose estimated peak intermediate rows reach this threshold is
+    # classified heavy even without an index-origin start
+    heavy_rows_threshold: int = 100000
+
     # ---- TPU-engine knobs (new; no reference analogue) ----
     table_capacity_min: int = 1024  # smallest binding-table capacity class
     # largest capacity class: 32M rows x 8 cols x int32 = 1 GiB, within one

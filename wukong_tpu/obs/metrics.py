@@ -309,6 +309,25 @@ class MetricsRegistry:
         return out
 
 
+def snapshot_labeled_value(snap: dict, name: str, **labels) -> float:
+    """Point lookup of one labeled series' value in a snapshot() dict
+    (0.0 when absent) — shared so snapshot-shape knowledge stays here."""
+    for s in (snap.get(name) or {}).get("series", []):
+        if all(s.get("labels", {}).get(k) == v for k, v in labels.items()):
+            return float(s.get("value", 0))
+    return 0.0
+
+
+def snapshot_histogram_mean(snap: dict, name: str) -> float | None:
+    """Mean of a snapshot()'d histogram's first series (sum/count), or
+    None when the histogram is absent or empty — the one place that knows
+    the snapshot shape, shared by every occupancy/latency-mean reader."""
+    series = (snap.get(name) or {}).get("series", [])
+    if not series or not series[0].get("count"):
+        return None
+    return series[0]["sum"] / series[0]["count"]
+
+
 # process-wide default registry (subsystems publish here unless handed one)
 _registry = MetricsRegistry()
 

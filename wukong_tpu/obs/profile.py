@@ -490,15 +490,25 @@ def render_top(k: int | None = None) -> tuple[str, dict]:
 
 
 def _lane_depths() -> dict:
-    """Lane activity from the registry: current pool queue depth plus
-    cumulative submissions per lane (submitted counters are labeled)."""
+    """Lane activity from the registry: current pool queue depth (total
+    and per lane), cumulative submissions per lane, and the heavy lane's
+    fused-group occupancy (mean members per flush)."""
     snap = get_registry().snapshot()
     out: dict = {}
     g = snap.get("wukong_pool_queue_depth")
     if g and g["series"]:
         out["queue_depth"] = int(g["series"][0].get("value", 0))
+    d = snap.get("wukong_pool_lane_depth")
+    for s in (d or {}).get("series", []):
+        lane = s.get("labels", {}).get("lane", "default") or "default"
+        out[f"depth[{lane}]"] = int(s.get("value", 0))
     c = snap.get("wukong_pool_submitted_total")
     for s in (c or {}).get("series", []):
         lane = s.get("labels", {}).get("lane", "default") or "default"
         out[f"submitted[{lane}]"] = int(s.get("value", 0))
+    from wukong_tpu.obs.metrics import snapshot_histogram_mean
+
+    occ = snapshot_histogram_mean(snap, "wukong_batch_heavy_occupancy")
+    if occ is not None:
+        out["heavy_occupancy_mean"] = round(occ, 2)
     return out

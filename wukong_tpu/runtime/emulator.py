@@ -132,12 +132,16 @@ class Emulator:
         for text in mix.heavies:
             q = Parser(self.proxy.str_server).parse(text)
             self._plan(q)
-            q._heavy_b = 0  # lazily-computed device batch size
             planned.append(("heavy", None, q))
 
         self._planned = planned
         self._probs = probs
         self._mixed_fail: dict[int, int] = {}
+        # explicit per-class heavy routing (replaces the old mutable
+        # q._heavy_b sentinel on the query object): "device" rides the
+        # compiled batch path with the plan-cache-backed slice count,
+        # "pool" is the recorded fall-back decision after a device failure
+        self._heavy_route: dict[int, str] = {}
         self._served = 0
 
         # precompile every device-batchable class BEFORE the measurement
@@ -390,10 +394,10 @@ class Emulator:
                                      count=served)
             return True
         if kind == "heavy" and q0.start_from_index() \
-                and getattr(q0, "_heavy_b", -1) >= 0:
-            if q0._heavy_b == 0:
-                q0._heavy_b = min(self.proxy.tpu.suggest_index_batch(q0), 64)
-            bh = q0._heavy_b
+                and self._heavy_route.get(cls, "device") == "device":
+            # slice count from the plan cache (signature + store version),
+            # not a mutable attribute on the shared query object
+            bh = self.proxy.heavy_index_batch(q0)
             W = 1
             if getattr(q0, "_many_warm", False) and self._p_cap > 1:
                 W = min(self._p_cap, 4)  # heavy tables are large; small window
@@ -410,8 +414,10 @@ class Emulator:
                         mode="index", W=1, B=bh, classes=[cls])
                     q0._many_warm = True
             except (WukongError, RuntimeError):
-                # RuntimeError: XLA OOM from the W-fold window footprint
-                q0._heavy_b = -1  # fall back to the pool for this class
+                # RuntimeError: XLA OOM from the W-fold window footprint.
+                # Record the route decision explicitly (was the q0._heavy_b
+                # = -1 sentinel): this class rides the pool from now on.
+                self._heavy_route[cls] = "pool"
                 return False
             self._served += bh * W
             self.monitor.add_latency((get_usec() - t0) / (bh * W), qtype=cls,
@@ -422,7 +428,7 @@ class Emulator:
     # ------------------------------------------------------------------
     def run_serving(self, texts: list, duration_s: float = 5.0,
                     warmup_s: float = 0.5, clients: int = 4,
-                    seed: int = 0) -> dict:
+                    seed: int = 0, weights=None, classes=None) -> dict:
         """Serving-path throughput: ``clients`` closed-loop threads each
         submit one query TEXT at a time through the proxy serving entry
         (parse cache -> plan cache -> batcher-or-direct -> engine) and
@@ -431,6 +437,11 @@ class Emulator:
         before/after pair of this number is `bench.py --serve-batched`'s
         headline. Starts the periodic metrics snapshotter when the
         ``metrics_snapshot_s`` knob asks for one (long-soak observability).
+
+        ``weights`` (aligned with ``texts``) draws a weighted mix instead
+        of uniform; ``classes`` (aligned ints, e.g. 0=light 1=heavy) adds
+        a per-class qps/latency breakdown to the result — the mixed
+        light+heavy benchmark's surface (`bench.py --serve-mixed`).
         """
         import threading
 
@@ -448,11 +459,17 @@ class Emulator:
         errors = [0] * clients
         lat: list[list] = [[] for _ in range(clients)]
         t_measure = [0.0]
+        p = None
+        if weights is not None:
+            p = np.asarray(weights, dtype=np.float64)
+            p = p / p.sum()
 
         def client(k: int) -> None:
             rng = np.random.default_rng(seed + k)
             while not stop.is_set():
-                text = texts[int(rng.integers(0, len(texts)))]
+                i = (int(rng.choice(len(texts), p=p)) if p is not None
+                     else int(rng.integers(0, len(texts))))
+                text = texts[i]
                 t0 = get_usec()
                 try:
                     q = self.proxy.serve_query(text, blind=True)
@@ -464,7 +481,7 @@ class Emulator:
                     continue
                 if time.monotonic() >= t_measure[0]:
                     served[k] += 1
-                    lat[k].append(get_usec() - t0)
+                    lat[k].append((i, get_usec() - t0))
 
         threads = [threading.Thread(target=client, args=(k,), daemon=True,
                                     name=f"serve-client-{k}")
@@ -479,7 +496,7 @@ class Emulator:
         if snap is not None:
             snap.stop()
         n = sum(served)
-        all_lat = sorted(x for xs in lat for x in xs)
+        all_lat = sorted(dt for xs in lat for (_i, dt) in xs)
         qps = n / duration_s if duration_s > 0 else 0.0
         p50 = all_lat[len(all_lat) // 2] if all_lat else 0
         p99 = all_lat[int(len(all_lat) * 0.99)] if all_lat else 0
@@ -488,10 +505,25 @@ class Emulator:
                  f"{'on' if Global.enable_batching else 'off'}, "
                  f"p50 {p50:,}us, p99 {p99:,}us, "
                  f"{sum(errors)} errors)")
-        return {"qps": round(qps, 1), "served": n, "errors": sum(errors),
-                "clients": clients, "duration_s": duration_s,
-                "batching": bool(Global.enable_batching),
-                "p50_us": int(p50), "p99_us": int(p99)}
+        out = {"qps": round(qps, 1), "served": n, "errors": sum(errors),
+               "clients": clients, "duration_s": duration_s,
+               "batching": bool(Global.enable_batching),
+               "p50_us": int(p50), "p99_us": int(p99)}
+        if classes is not None:
+            by_class: dict[int, list] = {}
+            for xs in lat:
+                for i, dt in xs:
+                    by_class.setdefault(int(classes[i]), []).append(dt)
+            out["by_class"] = {}
+            for c, vals in sorted(by_class.items()):
+                vals.sort()
+                out["by_class"][c] = {
+                    "served": len(vals),
+                    "qps": round(len(vals) / duration_s, 1),
+                    "p50_us": int(vals[len(vals) // 2]),
+                    "p99_us": int(vals[int(len(vals) * 0.99)]),
+                }
+        return out
 
     # ------------------------------------------------------------------
     # hot-spot heat scenario (ROADMAP item 3 acceptance fixture)

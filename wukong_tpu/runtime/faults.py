@@ -57,6 +57,7 @@ KNOWN_FAULT_SITES = frozenset({
     "wal.append",          # write-ahead-log append (store/wal.py)
     "replica.fetch",       # failover replica fetch (sharded_store)
     "checkpoint.write",    # checkpoint bundle write (runtime/recovery.py)
+    "batch.heavy.dispatch",  # fused heavy-lane dispatch (runtime/batcher.py)
 })
 
 

@@ -160,6 +160,8 @@ class Monitor:
                 log_info(line)
             for line in self.heat_lines(k=3):
                 log_info(line)
+            for line in self.lane_lines():
+                log_info(line)
             self._last_print = now
             self._last_cnt = self.cnt
 
@@ -248,6 +250,30 @@ class Monitor:
         """shard -> load-rate CDF (instantaneous fetches/s percentiles)."""
         rep = self.heat_report(k=None)
         return {s: d["load_rate_cdf"] for s, d in rep["shards"].items()}
+
+    def lane_lines(self) -> list[str]:
+        """Rolling-report line for the heavy lane: queue depth, fused
+        dispatches, and mean group occupancy — only once the lane has seen
+        traffic (quiet on light-only runs)."""
+        from wukong_tpu.obs.metrics import (
+            snapshot_histogram_mean,
+            snapshot_labeled_value,
+        )
+
+        snap = get_registry().snapshot()
+        heavy_sub = int(snapshot_labeled_value(
+            snap, "wukong_pool_submitted_total", lane="heavy"))
+        disp = sum(int(s.get("value", 0)) for s in (
+            snap.get("wukong_batch_heavy_dispatch_total") or {}).get(
+            "series", []))
+        if not heavy_sub and not disp:
+            return []
+        depth = int(snapshot_labeled_value(
+            snap, "wukong_pool_lane_depth", lane="heavy"))
+        mean = snapshot_histogram_mean(
+            snap, "wukong_batch_heavy_occupancy") or 0.0
+        return [f"HeavyLane: depth {depth}, {disp} fused dispatches "
+                f"({heavy_sub} lane submits), mean group {mean:.1f}"]
 
     def heat_lines(self, k: int = 3) -> list[str]:
         """Rolling-report lines: the top-k hot shards, only when any fetch

@@ -79,6 +79,9 @@ class Proxy:
         self._m_queries = self.metrics.counter(
             "wukong_queries_total", "Proxy queries by reply status",
             labels=("status",))
+        self._m_lane = self.metrics.counter(
+            "wukong_lane_routed_total",
+            "Plan-time light/heavy lane routing decisions", labels=("lane",))
         self._pool = None
         self._stream = None
         # serving fast path: parse cache (query text -> parsed query) and
@@ -138,6 +141,12 @@ class Proxy:
             pass
         return q
 
+    def _plan_version(self):
+        """The plan-cache version key: the store version (dynamic inserts /
+        stream commits bump it) + whether the cost planner is active."""
+        return (getattr(self.g, "version", 0),
+                self.planner is not None and Global.enable_planner)
+
     def _plan(self, q: SPARQLQuery, plan_text: str | None = None) -> None:
         if plan_text is not None:
             if Global.enable_planner:
@@ -150,8 +159,7 @@ class Proxy:
         # the recorded plan recipe (dynamic inserts / stream commits bump
         # the version, so stale plans never apply)
         sig = template_signature(q)
-        version = (getattr(self.g, "version", 0),
-                   self.planner is not None and Global.enable_planner)
+        version = self._plan_version()
         if sig is not None and self._plan_cache.lookup(q, sig, version):
             return
         parsed = snapshot_patterns(q) if sig is not None else None
@@ -304,13 +312,70 @@ class Proxy:
         return q, total_us
 
     def _plan_prepared(self, qq: SPARQLQuery, blind, plan_text) -> None:
-        """Shared prepare tail: blind mode, resilience knobs, planning."""
+        """Shared prepare tail: blind mode, resilience knobs, planning,
+        plan-time lane routing."""
         qq.mt_factor = 1
         qq.result.blind = Global.silent if blind is None else blind
         # per-query deadline + work budget from the resilience knobs
         # (query_deadline_ms / query_budget_rows; None when both off)
         qq.deadline = Deadline.from_config()
         self._plan(qq, plan_text)
+        qq.lane = self.classify_lane(qq)
+        self._m_lane.labels(lane=qq.lane).inc()
+
+    # ------------------------------------------------------------------
+    # heavy-lane routing (runtime/batcher.py heavy path)
+    # ------------------------------------------------------------------
+    def classify_lane(self, q: SPARQLQuery) -> str:
+        """Plan-time light/heavy routing: index-origin starts are heavy
+        (wide-table scans — the Wukong+G CPU-vs-GPU split); other shapes
+        are heavy when the optimizer's ``estimate_chain`` peak reaches
+        ``heavy_rows_threshold``. Memoized per template signature + store
+        version through the plan cache, so the estimate walk runs once per
+        template, not per query."""
+        try:
+            if q.start_from_index():
+                return "heavy"
+        except WukongError:
+            return "light"
+        if self.planner is None or not Global.enable_planner:
+            return "light"
+        sig = template_signature(q)
+        if sig is None:
+            return "light"  # recursive shapes: unestimated, route light
+        pats = list(q.pattern_group.patterns)
+
+        threshold = max(int(Global.heavy_rows_threshold), 1)
+
+        def compute() -> str:
+            try:
+                ests = self.planner.estimate_chain(pats)
+            except Exception:
+                ests = None
+            return "heavy" if ests and max(ests) >= threshold else "light"
+
+        # the threshold is runtime-mutable: it joins the memo key so a
+        # knob change takes effect immediately instead of serving stale
+        # decisions until the next store-version bump
+        return self._plan_cache.aux(
+            "lane", sig, (*self._plan_version(), threshold), compute)
+
+    def heavy_index_batch(self, q: SPARQLQuery) -> int:
+        """Plan-cache-backed device slice count for an index-origin query:
+        ``suggest_index_batch`` memoized on template signature + store
+        version and capped by ``heavy_batch_max`` (the emulator's old
+        per-query-object ``_heavy_b`` hack, now a shared plan fact)."""
+        if self.tpu is None:
+            return 1
+        cap = max(int(Global.heavy_batch_max), 1)
+        sig = template_signature(q)
+        # cap in the memo key: heavy_batch_max is runtime-mutable (e.g.
+        # shrunk after a device OOM) and must apply to already-seen
+        # templates immediately
+        return int(self._plan_cache.aux(
+            "heavy_b", sig, (*self._plan_version(), cap),
+            lambda: max(min(self.tpu.suggest_index_batch(q, cap=cap), cap),
+                        1)))
 
     # ------------------------------------------------------------------
     # serving-path micro-batching (runtime/batcher.py)
@@ -324,8 +389,9 @@ class Proxy:
                 if self._batcher is None:  # must share ONE coalescer
                     cpu = self.cpu or (self.tpu.cpu
                                        if self.tpu is not None else None)
-                    self._batcher = QueryBatcher(cpu, self.tpu,
-                                                 pool=lambda: self._pool)
+                    self._batcher = QueryBatcher(
+                        cpu, self.tpu, pool=lambda: self._pool,
+                        suggest_heavy_b=self.heavy_index_batch)
         return self._batcher  # unguarded: write-once reference, non-None past init
 
     def _serve_execute(self, q: SPARQLQuery, eng,

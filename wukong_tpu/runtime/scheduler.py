@@ -53,14 +53,33 @@ _POOLS: "weakref.WeakSet" = weakref.WeakSet()
 
 def _queue_depth() -> int:
     return sum(sum(len(dq) for dq in p.queues) + len(p.stream_queue)
-               + len(p.batch_queue) + len(p.rebuild_queue)
+               + len(p.batch_queue) + len(p.heavy_queue)
+               + len(p.heavy_slices) + len(p.rebuild_queue)
                for p in list(_POOLS))
 
 
 get_registry().gauge(
     "wukong_pool_queue_depth",
-    "Queries waiting in pool queues (incl. stream/batch/rebuild lanes)"
+    "Queries waiting in pool queues (incl. stream/batch/heavy/rebuild lanes)"
 ).set_function(_queue_depth)
+
+
+def _lane_depth_series() -> dict:
+    """Per-lane queue depth across every live pool — the /top lane view's
+    pull source (depth by lane, not just the total)."""
+    acc = {"default": 0, "batch": 0, "heavy": 0, "stream": 0, "rebuild": 0}
+    for p in list(_POOLS):
+        acc["default"] += sum(len(dq) for dq in p.queues)
+        acc["batch"] += len(p.batch_queue)
+        acc["heavy"] += len(p.heavy_queue) + len(p.heavy_slices)
+        acc["stream"] += len(p.stream_queue)
+        acc["rebuild"] += len(p.rebuild_queue)
+    return {(k,): v for k, v in acc.items()}
+
+
+get_registry().gauge(
+    "wukong_pool_lane_depth", "Queries waiting per pool lane",
+    labels=("lane",)).set_function(_lane_depth_series)
 
 
 class EnginePool:
@@ -124,6 +143,20 @@ class EnginePool:
         # here are fire-and-forget for the pool's result bookkeeping.
         self.batch_queue = collections.deque()  # guarded by: _batch_lock
         self._batch_lock = make_lock("pool.batch")
+        # heavy lane: fused index-origin dispatches + their split slices
+        # (runtime/batcher.py HeavyGroup/_HeavySlice), same fire-and-forget
+        # contract as the batch lane but WEIGHTED: at most
+        # ceil(n * heavy_lane_pct / 100) engines (min 1) execute heavy
+        # items concurrently, so a heavy flood can never occupy every
+        # engine — interactive light traffic always keeps capacity.
+        self.heavy_queue = collections.deque()  # guarded by: _heavy_lock
+        # split-slice continuations in their own deque: they are
+        # cap-exempt (their group already holds a slot) and exist only
+        # during an active split, so the pop path stays O(1) instead of
+        # scanning the group queue for them
+        self.heavy_slices = collections.deque()  # guarded by: _heavy_lock
+        self._heavy_lock = make_lock("pool.heavy")
+        self._heavy_inflight = 0  # guarded by: _heavy_lock
         # rebuild lane: background shard-rebuild jobs (runtime/recovery.py
         # RebuildJob), drained only when every other lane is empty —
         # healing soaks idle capacity, never displaces serving traffic.
@@ -205,6 +238,7 @@ class EnginePool:
         if item is not None:
             qid, _q = item
             if qid is None:  # batch-lane group: settle its member futures
+                self._heavy_done(_q)  # a heavy slot died with the thread
                 fail = getattr(_q, "fail_all", None)
                 if fail is not None:
                     fail(RuntimeError(
@@ -256,6 +290,16 @@ class EnginePool:
                     fail = getattr(group, "fail_all", None)
                     if fail is not None:
                         fail(RuntimeError("engine pool dead"))
+                # ...or the heavy lane: groups and split slices alike
+                with self._heavy_lock:
+                    heavy_stranded = (list(self.heavy_queue)
+                                      + list(self.heavy_slices))
+                    self.heavy_queue.clear()
+                    self.heavy_slices.clear()
+                for _qid, item2 in heavy_stranded:
+                    fail = getattr(item2, "fail_all", None)
+                    if fail is not None:
+                        fail(RuntimeError("engine pool dead"))
                 # ...or the rebuild lane: same fire-and-forget settlement
                 with self._rebuild_lock:
                     rebuild_stranded = list(self.rebuild_queue)
@@ -281,13 +325,25 @@ class EnginePool:
         members' futures, so no pool-side result entry is created (returns
         -1). A dead pool fails the group immediately via fail_all.
 
+        lane="heavy" enqueues a fused heavy dispatch (HeavyGroup) or one of
+        its split slices with the batch lane's fire-and-forget contract,
+        drained under the weighted heavy_lane_pct concurrency cap so heavy
+        work never starves interactive traffic.
+
         lane="rebuild" enqueues a background shard-rebuild job
         (runtime/recovery.py RebuildJob) with the same fire-and-forget
         contract, drained only when every other lane is empty."""
-        if lane in ("batch", "rebuild"):
+        if lane in ("batch", "heavy", "rebuild"):
             _M_SUBMITTED.labels(lane=lane).inc()
-            lock = self._batch_lock if lane == "batch" else self._rebuild_lock
-            queue = self.batch_queue if lane == "batch" else self.rebuild_queue  # unguarded: binds the deque reference only (immutable attr); mutated below under `lock`
+            lock = {"batch": self._batch_lock, "heavy": self._heavy_lock,
+                    "rebuild": self._rebuild_lock}[lane]
+            if lane == "heavy" and getattr(query, "heavy_continuation",
+                                           False):
+                queue = self.heavy_slices  # unguarded: binds the deque reference only (immutable attr); mutated below under `lock`
+            else:
+                queue = {"batch": self.batch_queue,  # unguarded: reference binding only, as above
+                         "heavy": self.heavy_queue,  # unguarded: reference binding only, as above
+                         "rebuild": self.rebuild_queue}[lane]  # unguarded: reference binding only, as above
             with self._route_lock:
                 if all(self._dead[k] for k in range(self.n)):
                     fail = getattr(query, "fail_all", None)
@@ -376,6 +432,25 @@ class EnginePool:
         return out
 
     # ------------------------------------------------------------------
+    def alive_count(self) -> int:
+        """Engines not declared dead (the heavy split fan-out bound)."""
+        return sum(1 for t in range(self.n) if not self._dead[t])  # unguarded: report-only snapshot, like health()
+
+    def _heavy_cap(self) -> int:
+        """Max engines concurrently executing heavy-lane items."""
+        return max((self.n * max(int(Global.heavy_lane_pct), 0)) // 100, 1)
+
+    def _heavy_done(self, query) -> None:
+        """Release the weighted heavy slot an engine-loop pop took. Keyed
+        on the item's lane tag: only slot-counted heavy pops incremented
+        (cap-exempt slice continuations did not take one)."""
+        if getattr(query, "lane", None) != "heavy" \
+                or getattr(query, "heavy_continuation", False):
+            return
+        with self._heavy_lock:
+            self._heavy_inflight = max(self._heavy_inflight - 1, 0)
+
+    # ------------------------------------------------------------------
     def _neighbors(self, tid: int) -> list[int]:
         """Stealing pattern (engine.hpp:186-207): 0=pair, 1=ring."""
         if self.n <= 1:
@@ -399,6 +474,17 @@ class EnginePool:
             with self.locks[nb]:
                 if self.queues[nb]:
                     return self.queues[nb].pop()
+        # heavy lane after every interactive source, under the weighted
+        # concurrency cap: fused index-origin dispatches soak the engines
+        # light traffic is not using, never all of them. Split SLICES are
+        # cap-exempt continuations — their group already holds a slot, and
+        # capping them would stall its gather barrier behind itself.
+        with self._heavy_lock:
+            if self.heavy_slices:
+                return self.heavy_slices.popleft()
+            if self.heavy_queue and self._heavy_inflight < self._heavy_cap():
+                self._heavy_inflight += 1
+                return self.heavy_queue.popleft()
         # stream lane next-to-last: standing-query work fills idle capacity
         with self._stream_lock:
             if self.stream_queue:
@@ -440,7 +526,7 @@ class EnginePool:
             qid, query = item
             self._inflight[tid] = item
             self._busy_since[tid] = get_usec()
-            if qid is None:  # batch lane: a fused group, fire-and-forget
+            if qid is None:  # batch/heavy lanes: fire-and-forget items
                 try:
                     from wukong_tpu.runtime import faults
 
@@ -453,6 +539,7 @@ class EnginePool:
                     fail = getattr(query, "fail_all", None)
                     if fail is not None:
                         fail(e)
+                self._heavy_done(query)  # release the weighted heavy slot
                 self._busy_since[tid] = 0
                 self._inflight[tid] = None
                 self._respawns[tid] = 0
