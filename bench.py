@@ -714,7 +714,18 @@ def serve_mixed_main(device_ok: bool) -> None:
                      "wukong_batch_heavy_fused_total",
                      "wukong_batch_heavy_slices_total",
                      "wukong_batch_heavy_fallback_total",
+                     "wukong_batch_heavy_split_total",
                      "wukong_lane_routed_total")}
+    # heavy_split_threshold tuning surface: how often fused dispatches
+    # split vs ran whole under the current threshold (each split part
+    # pays the per-dispatch fixed cost — see the README knob row)
+    split_counts = {s["labels"].get("decision", "?"): s["value"]
+                    for s in snap.get("wukong_batch_heavy_split_total",
+                                      {}).get("series", [])}
+    print(f"# heavy split decisions @threshold="
+          f"{Global.heavy_split_threshold}: "
+          f"split={split_counts.get('split', 0)} "
+          f"no_split={split_counts.get('no_split', 0)}", file=sys.stderr)
     from wukong_tpu.obs.metrics import snapshot_histogram_mean
 
     occ = snapshot_histogram_mean(snap, "wukong_batch_heavy_occupancy")
@@ -744,6 +755,141 @@ def serve_mixed_main(device_ok: bool) -> None:
             "dataset": DATASET_NOTES["lubm"],
         },
     }, "BENCH_SERVE_MIXED.json")
+
+
+def cyclic_main(device_ok: bool) -> None:
+    """`bench.py --cyclic`: the cyclic workload suite (triangle / diamond /
+    4-clique synthetic worlds + the WatDiv-based cyclic query set), each
+    executed with the walk forced and the WCOJ tensor join forced on the
+    SAME planned query, rows verified identical. Headline: the triangle
+    speedup (the walk materializes the quadratic wedge set; acceptance
+    >= 5x). Artifact: BENCH_CYCLIC.json (scripts/bench_report.py trends
+    the headline, higher-is-better)."""
+    import numpy as np
+
+    from wukong_tpu.config import Global
+    from wukong_tpu.engine.cpu import CPUEngine
+    from wukong_tpu.join.wcoj import WCOJExecutor
+    from wukong_tpu.loader.datagen import (
+        generate_clique4,
+        generate_diamond,
+        generate_triangle,
+        watdiv_cyclic_patterns,
+    )
+    from wukong_tpu.loader.watdiv import generate_watdiv
+    from wukong_tpu.planner.optimizer import Planner
+    from wukong_tpu.planner.stats import Stats
+    from wukong_tpu.sparql.ir import Pattern, SPARQLQuery
+    from wukong_tpu.store.gstore import build_partition
+    from wukong_tpu.types import OUT
+
+    m_tri = int(os.environ.get("WUKONG_CYCLIC_M", "2000"))
+    reps = int(os.environ.get("WUKONG_CYCLIC_REPS", "3"))
+
+    def mkq(spec):
+        q = SPARQLQuery()
+        q.pattern_group.patterns = [Pattern(s, p, OUT, o)
+                                    for (s, p, o) in spec["patterns"]]
+        q.result.nvars = len(spec["vars"])
+        q.result.required_vars = list(spec["vars"])
+        q.result.blind = True
+        return q
+
+    worlds = [
+        ("triangle", *generate_triangle(m=m_tri, noise=8, seed=0)),
+        ("diamond", *generate_diamond(m=400, noise=4, seed=0)),
+        ("clique4", *generate_clique4(n=1200, fan=10, ncliques=40, seed=0)),
+    ]
+    detail = {}
+    for name, triples, meta in worlds:
+        g = build_partition(triples, 0, 1)
+        stats = Stats.generate(triples)
+        planner = Planner(stats)
+        detail[name] = _cyclic_case(name, g, stats, planner, meta, mkq,
+                                    CPUEngine, WCOJExecutor, reps)
+    # WatDiv-based cyclic set (social triangles/pentagon over the shaped
+    # e-commerce world)
+    wscale = int(os.environ.get("WUKONG_CYCLIC_WATDIV_SCALE", "60"))
+    wtriples, _lay = generate_watdiv(wscale, seed=0)
+    wg = build_partition(wtriples, 0, 1)
+    wstats = Stats.generate(wtriples)
+    wplanner = Planner(wstats)
+    for name, spec in watdiv_cyclic_patterns().items():
+        detail[name] = _cyclic_case(name, wg, wstats, wplanner, spec, mkq,
+                                    CPUEngine, WCOJExecutor, reps)
+    tri = detail["triangle"]
+    _emit_final({
+        "metric": f"cyclic suite: WCOJ vs walk (triangle m={m_tri} "
+                  f"headline; diamond/clique4 + WatDiv-{wscale} cyclic "
+                  "set in detail)",
+        "value": tri["speedup"],
+        "unit": "speedup",
+        "triangle_speedup": tri["speedup"],
+        "triangle_walk_ms": tri["walk_ms"],
+        "triangle_wcoj_ms": tri["wcoj_ms"],
+        "rows_identical": all(d["rows_identical"] for d in detail.values()),
+        "auto_strategies": {n: d["auto_strategy"] for n, d in detail.items()},
+        "backend": "cpu",  # host executors on both sides (the XLA path
+        # rides the same kernels; the strategy win is algorithmic)
+        "detail": {**detail,
+                   "knobs": {"wcoj_ratio": Global.wcoj_ratio,
+                             "wcoj_min_rows": Global.wcoj_min_rows,
+                             "reps": reps}},
+    }, "BENCH_CYCLIC.json")
+
+
+def _cyclic_case(name, g, stats, planner, spec, mkq, CPUEngine,
+                 WCOJExecutor, reps: int) -> dict:
+    """One cyclic-suite case: plan once, run walk-forced and wcoj-forced,
+    compare rows and best-of-reps wall time."""
+    from wukong_tpu.config import Global
+
+    def planned():
+        q = mkq(spec)
+        planner.generate_plan(q)
+        return q
+
+    auto = planner.choose_strategy(planned().pattern_group.patterns)
+    cpu = CPUEngine(g)
+    wc = WCOJExecutor(g, stats=stats)
+    wc.tables.clear()
+
+    def run(engine, blind=True):
+        best, rows = None, None
+        nonblind = None
+        for _ in range(reps):
+            q = planned()
+            t0 = time.perf_counter()
+            engine.execute(q)
+            dt = (time.perf_counter() - t0) * 1e3
+            best = dt if best is None else min(best, dt)
+            rows = q.result.nrows
+            assert q.result.status_code == 0, (name, q.result.status_code)
+        # one non-blind run for row-level comparison
+        q = planned()
+        q.result.blind = False
+        engine.execute(q)
+        nonblind = {tuple(r) for r in q.result.table.tolist()}
+        return best, rows, nonblind
+
+    walk_ms, walk_rows, walk_set = run(cpu)
+    wcoj_ms, wcoj_rows, wcoj_set = run(wc)
+    return {
+        "walk_ms": round(walk_ms, 1), "wcoj_ms": round(wcoj_ms, 1),
+        "speedup": round(walk_ms / wcoj_ms, 2) if wcoj_ms else None,
+        "rows": int(walk_rows),
+        "rows_identical": bool(walk_rows == wcoj_rows
+                               and walk_set == wcoj_set),
+        "auto_strategy": auto,
+        "est_peak_over_final": _est_ratio(planner, planned()),
+    }
+
+
+def _est_ratio(planner, q) -> float | None:
+    ests = planner.estimate_chain(q.pattern_group.patterns)
+    if not ests:
+        return None
+    return round(max(ests) / max(ests[-1], 1.0), 1)
 
 
 def watdiv_main(device_ok: bool) -> None:
@@ -1899,6 +2045,9 @@ def main():
         return
     if "--emu" in sys.argv:
         emu_main(device_ok)
+        return
+    if "--cyclic" in sys.argv:
+        cyclic_main(device_ok)
         return
     if "--watdiv" in sys.argv:
         watdiv_main(device_ok)

@@ -34,7 +34,7 @@ import sys
 RUNG_RE = re.compile(r"^(BENCH(?:_[A-Za-z0-9]+)*?)_r(\d+)$")
 
 LOWER_BETTER = ("us", "ms", "ns", "sec")
-HIGHER_BETTER = ("q/s", "qps", "/s")
+HIGHER_BETTER = ("q/s", "qps", "/s", "speedup")
 
 
 def _direction(unit: str) -> int:
@@ -61,6 +61,11 @@ def _headline(d: dict) -> dict | None:
     for key in ("batched_qps", "mixed_qps", "qps", "thpt_qps"):
         if isinstance(d.get(key), (int, float)):
             return {"value": float(d[key]), "unit": "q/s", "metric": key}
+    # cyclic suite: the triangle walk-vs-wcoj ratio (BENCH_CYCLIC.json;
+    # higher is better via the "speedup" unit)
+    if isinstance(d.get("triangle_speedup"), (int, float)):
+        return {"value": float(d["triangle_speedup"]), "unit": "speedup",
+                "metric": "triangle_speedup"}
     return None
 
 

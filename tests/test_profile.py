@@ -110,7 +110,7 @@ step  pattern                                    est_rows   est_cost
    0  (11 0 IN -2)                                  275.0      614.0
    1  (-2 11 OUT -3)                                275.0      889.0
    2  (-2 7 IN -1)                                7,473.0   15,285.0
-planner: cost-based, est total cost 16,788.0"""
+planner: cost-based, strategy: walk, est total cost 16,788.0"""
 
 
 def test_explain_golden(proxy):

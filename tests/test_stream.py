@@ -239,6 +239,69 @@ def test_windowed_delta_matches_window_oracle(world, spec):
     assert np.array_equal(np.asarray(sorted(acc), dtype=np.int64), oracle)
 
 
+def test_sliding_window_incremental_retraction_oracle(world, monkeypatch):
+    """PR 9 follow-up (b): per-result support counting makes retirement
+    incremental. After EVERY epoch of a sliding window the standing set
+    must match the from-scratch oracle over base + surviving epochs —
+    including chain results whose derivations span epochs (they retract
+    exactly when their oldest contributing epoch retires). The full-
+    refresh fallback is disabled after registration, so this passes only
+    if the incremental path (overdelete + support + re-derive) carries
+    every retirement alone."""
+    triples, ss, perm = world
+    base, live = split(triples, perm, len(triples) // 2)
+    live = live[:12000]
+    spec = WindowSpec(size=3, slide=1)
+    ctx = StreamContext([build_partition(base, 0, 1)], ss)
+    qid = ctx.register(Q_CHAIN, window=spec, base_triples=base)
+
+    def _no_refresh(*a, **k):  # any fallback is a silent perf regression
+        raise AssertionError("full-refresh fallback used")
+
+    monkeypatch.setattr(ctx.continuous, "_snapshot", _no_refresh)
+    batches = [b for _, b in ReplaySource(live, batch_size=2000)]
+    retracted = 0
+    for k, b in enumerate(batches):
+        ctx.feed(b)
+        oracle = full_run(
+            np.concatenate([base, _surviving(batches[:k + 1], spec)]),
+            ss, Q_CHAIN)
+        assert np.array_equal(ctx.result_set(qid), oracle), f"epoch {k + 1}"
+        retracted += sum(len(d.rows) for d in ctx.poll(qid)
+                         if d.sign == -1)
+    assert retracted > 0  # retirement actually retracted rows
+    # the sink replay (additions minus retractions) rebuilds the set
+    acc: set = set()
+    for d in ctx.poll(qid):
+        rows = set(map(tuple, d.rows.tolist()))
+        acc = acc | rows if d.sign > 0 else acc - rows
+    assert np.array_equal(np.asarray(sorted(acc), dtype=np.int64),
+                          ctx.result_set(qid))
+
+
+def test_support_index_counts_and_base_fastpath():
+    """SupportIndex unit semantics: live-epoch evidence counts, the
+    base-supported permanent rows, and evidence-exhaustion on retire."""
+    from wukong_tpu.stream.windows import SupportIndex
+
+    si = SupportIndex()
+    si.note_base({(1,), (2,)})
+    si.note_epoch(1, {(2,), (3,), (4,)})
+    si.note_epoch(2, {(3,)})
+    assert si.support_of((3,)) == 2  # two live epochs derived it
+    assert si.support_of((2,)) == 2  # base + epoch 1
+    assert si.support_of((1,)) == 1  # base only
+    dead = si.retire([1])
+    # (4,) lost its only evidence; (3,) still has epoch 2; (2,) is
+    # base-supported and never reported dead
+    assert dead == {(4,)}
+    assert si.support_of((3,)) == 1
+    assert si.retire([2]) == {(3,)}
+    si.note_epoch(3, {(5,)})
+    si.reset()
+    assert si.support_of((5,)) == 0 and si.support_of((1,)) == 1
+
+
 def test_tumbling_mid_window_never_joins_previous_window(world):
     """At a mid-window epoch a tumbling query's result must reflect ONLY
     the current (open) window — never transient rows joined against the

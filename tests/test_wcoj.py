@@ -1,0 +1,544 @@
+"""Tensor-join (WCOJ) execution: oracle identity, routing, chaos, gate.
+
+The acceptance bar (ISSUE 9): the WCOJ path returns byte-identical result
+rows to the walk AND to the independent brute-force BGP oracle on triangle,
+diamond, and 4-clique worlds; acyclic LUBM reference shapes route ``walk``
+under ``join_strategy auto``; and a ``join.materialize`` fault degrades the
+query to the walk — never to an error.
+"""
+
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, "tests")
+from bgp_oracle import TripleIndex, eval_bgp  # noqa: E402
+
+from wukong_tpu.config import Global
+from wukong_tpu.engine.cpu import CPUEngine
+from wukong_tpu.join import JOIN_STRATEGIES
+from wukong_tpu.join.kernels import (
+    intersect_many,
+    intersect_sorted,
+    member_sorted,
+    pair_member,
+)
+from wukong_tpu.join.qgraph import analyze
+from wukong_tpu.join.wcoj import WCOJExecutor
+from wukong_tpu.loader.datagen import (
+    CyclicStrings,
+    cyclic_query_text,
+    generate_clique4,
+    generate_diamond,
+    generate_triangle,
+)
+from wukong_tpu.planner.heuristic import heuristic_plan
+from wukong_tpu.planner.optimizer import Planner
+from wukong_tpu.planner.stats import Stats
+from wukong_tpu.runtime import faults
+from wukong_tpu.runtime.faults import FaultPlan, FaultSpec
+from wukong_tpu.runtime.proxy import Proxy
+from wukong_tpu.sparql.ir import Pattern, SPARQLQuery
+from wukong_tpu.types import IN, OUT
+from wukong_tpu.utils.errors import ErrorCode
+
+pytestmark = pytest.mark.wcoj
+
+WORLDS = {
+    "triangle": lambda: generate_triangle(m=60, noise=3, seed=1),
+    "diamond": lambda: generate_diamond(m=40, noise=2, seed=1),
+    "clique4": lambda: generate_clique4(n=120, fan=6, ncliques=8, seed=1),
+}
+
+
+@pytest.fixture(scope="module", params=sorted(WORLDS))
+def world(request):
+    from wukong_tpu.store.gstore import build_partition
+
+    triples, meta = WORLDS[request.param]()
+    g = build_partition(triples, 0, 1)
+    stats = Stats.generate(triples)
+    return request.param, triples, g, stats, meta
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults_and_knobs():
+    faults.clear()
+    yield
+    faults.clear()
+    Global.join_strategy = "auto"
+    Global.wcoj_ratio = 4
+    Global.wcoj_min_rows = 8192
+
+
+def mkq(meta, blind=False) -> SPARQLQuery:
+    q = SPARQLQuery()
+    q.pattern_group.patterns = [Pattern(s, p, OUT, o)
+                                for (s, p, o) in meta["patterns"]]
+    q.result.nvars = len(meta["vars"])
+    q.result.required_vars = list(meta["vars"])
+    q.result.blind = blind
+    return q
+
+
+def rows_of(q) -> set:
+    return set(map(tuple, q.result.table.tolist()))
+
+
+# ---------------------------------------------------------------------------
+# oracle identity: wcoj == walk == brute force
+# ---------------------------------------------------------------------------
+
+def test_wcoj_matches_walk_and_bruteforce_oracle(world):
+    name, triples, g, stats, meta = world
+    qw = mkq(meta)
+    heuristic_plan(qw)
+    CPUEngine(g).execute(qw)
+    assert qw.result.status_code == ErrorCode.SUCCESS
+
+    qj = mkq(meta)
+    heuristic_plan(qj)
+    WCOJExecutor(g, stats=stats).execute(qj)
+    assert qj.result.status_code == ErrorCode.SUCCESS
+
+    assert rows_of(qw) == rows_of(qj), name
+    oracle = set(eval_bgp(TripleIndex(triples), meta["patterns"],
+                          meta["vars"]))
+    assert rows_of(qj) == oracle, name
+
+
+def test_wcoj_blind_counts_match_walk(world):
+    name, _triples, g, stats, meta = world
+    qw = mkq(meta, blind=True)
+    heuristic_plan(qw)
+    CPUEngine(g).execute(qw)
+    qj = mkq(meta, blind=True)
+    heuristic_plan(qj)
+    WCOJExecutor(g, stats=stats).execute(qj)
+    assert qw.result.nrows == qj.result.nrows, name
+
+
+def test_wcoj_cost_planned_order_identical(world):
+    """The optimizer's plan order (not just the heuristic's) feeds the
+    same analyzer and returns the same rows."""
+    name, _triples, g, stats, meta = world
+    pl = Planner(stats)
+    qw, qj = mkq(meta), mkq(meta)
+    pl.generate_plan(qw)
+    pl.generate_plan(qj)
+    CPUEngine(g).execute(qw)
+    WCOJExecutor(g, stats=stats).execute(qj)
+    assert qw.result.status_code == qj.result.status_code \
+        == ErrorCode.SUCCESS
+    assert rows_of(qw) == rows_of(qj), name
+
+
+# ---------------------------------------------------------------------------
+# query-graph analyzer
+# ---------------------------------------------------------------------------
+
+def test_qgraph_detects_cycles(world):
+    name, _t, _g, stats, meta = world
+    q = mkq(meta)
+    heuristic_plan(q)
+    qg = analyze(q.pattern_group.patterns, stats=stats)
+    assert qg.supported and qg.cyclic
+    # the elimination order covers every variable exactly once
+    assert sorted(qg.order) == sorted(qg.vars)
+
+
+def test_qgraph_acyclic_chain_and_star():
+    chain = [Pattern(-1, 2, OUT, -2), Pattern(-2, 3, OUT, -3)]
+    star = [Pattern(-1, 2, OUT, -2), Pattern(-1, 3, OUT, -3),
+            Pattern(-1, 4, OUT, -4)]
+    for pats in (chain, star):
+        qg = analyze(pats)
+        assert qg.supported and not qg.cyclic
+
+
+def test_qgraph_parallel_edges_are_cyclic():
+    qg = analyze([Pattern(-1, 2, OUT, -2), Pattern(-1, 3, OUT, -2)])
+    assert qg.supported and qg.cyclic
+
+
+def test_qgraph_unsupported_shapes_route_walk():
+    # variable predicate / self-loop / meta expansion are not wcoj shapes
+    assert not analyze([Pattern(-1, -9, OUT, -2)]).supported
+    assert not analyze([Pattern(-1, 2, OUT, -1)]).supported
+    assert not analyze([Pattern(-1, 1, OUT, -2)]).supported  # ?x type ?t
+    assert not analyze([]).supported
+
+
+def test_qgraph_engine_form_orientation():
+    """IN-direction patterns are read triple-wise: (o, p, s)."""
+    # planned form of (?b <-p- ?a): anchor ?b, direction IN
+    qg = analyze([Pattern(-2, 2, IN, -1), Pattern(-1, 3, OUT, -2)])
+    assert qg.supported and qg.cyclic  # both edges join the same pair
+
+
+# ---------------------------------------------------------------------------
+# sorted-array kernels
+# ---------------------------------------------------------------------------
+
+def test_kernels_member_and_intersect():
+    a = np.array([1, 3, 5, 7, 9], dtype=np.int64)
+    vals = np.array([0, 1, 2, 5, 9, 10], dtype=np.int64)
+    assert member_sorted(a, vals).tolist() == \
+        [False, True, False, True, True, False]
+    b = np.array([3, 4, 5, 9, 11], dtype=np.int64)
+    assert intersect_sorted(a, b).tolist() == [3, 5, 9]
+    assert intersect_many([a, b, np.array([5, 9], dtype=np.int64)]) \
+        .tolist() == [5, 9]
+    assert member_sorted(np.empty(0, dtype=np.int64), vals).sum() == 0
+
+
+def test_kernels_pair_member_matches_segment_probe():
+    from wukong_tpu.store.segment import CSRSegment
+
+    rng = np.random.default_rng(3)
+    k = rng.integers(0, 50, 400)
+    v = rng.integers(0, 50, 400)
+    seg = CSRSegment.from_pairs(k, v)
+    anchors = rng.integers(0, 60, 300)
+    vals = rng.integers(0, 60, 300)
+    got = pair_member(seg.keys, seg.offsets, seg.edges, anchors, vals)
+    want = seg.contains_pair(anchors, vals)
+    assert np.array_equal(got, want)
+
+
+def test_kernels_jit_compile_parity():
+    """The same kernel source traces under XLA and agrees with NumPy."""
+    from wukong_tpu.join.kernels import jit_kernels
+    from wukong_tpu.store.segment import CSRSegment
+
+    member, pair = jit_kernels()
+    rng = np.random.default_rng(5)
+    s = np.unique(rng.integers(0, 100, 60))
+    vals = rng.integers(0, 110, 80)
+    assert np.array_equal(np.asarray(member(s, vals)),
+                          member_sorted(s, vals))
+    seg = CSRSegment.from_pairs(rng.integers(0, 30, 200),
+                                rng.integers(0, 30, 200))
+    anchors = rng.integers(0, 40, 100)
+    pvals = rng.integers(0, 40, 100)
+    assert np.array_equal(
+        np.asarray(pair(seg.keys, seg.offsets, seg.edges, anchors, pvals)),
+        pair_member(seg.keys, seg.offsets, seg.edges, anchors, pvals))
+
+
+# ---------------------------------------------------------------------------
+# strategy selection
+# ---------------------------------------------------------------------------
+
+def test_choose_strategy_knob_and_ratio(world):
+    name, _t, _g, stats, meta = world
+    pl = Planner(stats)
+    q = mkq(meta)
+    pl.generate_plan(q)
+    pats = q.pattern_group.patterns
+    Global.join_strategy = "walk"
+    assert pl.choose_strategy(pats) == "walk"
+    Global.join_strategy = "wcoj"
+    assert pl.choose_strategy(pats) == "wcoj"
+    Global.join_strategy = "auto"
+    out = pl.choose_strategy(pats)
+    assert out in JOIN_STRATEGIES
+    # with the floors dropped, a cyclic blowup shape must route wcoj
+    Global.wcoj_min_rows = 1
+    Global.wcoj_ratio = 1
+    assert pl.choose_strategy(pats) == "wcoj", name
+
+
+def test_choose_strategy_acyclic_always_walks(world):
+    _name, _t, _g, stats, meta = world
+    pl = Planner(stats)
+    pid = next(iter(meta["P"].values()))
+    chain = [Pattern(-1, pid, OUT, -2), Pattern(-2, pid, OUT, -3)]
+    q = SPARQLQuery()
+    q.pattern_group.patterns = chain
+    q.result.nvars = 3
+    q.result.required_vars = [-1, -2, -3]
+    heuristic_plan(q)
+    Global.wcoj_min_rows = 1
+    Global.wcoj_ratio = 1
+    assert pl.choose_strategy(q.pattern_group.patterns) == "walk"
+
+
+LUBM_PREFIX = """
+PREFIX rdf: <http://www.w3.org/1999/02/22-rdf-syntax-ns#>
+PREFIX ub: <http://swat.cse.lehigh.edu/onto/univ-bench.owl#>
+"""
+#: the reference LUBM basic-suite shapes (wukong lubm_q1..q7) — q1/q2 are
+#: the cyclic LUBM Q2/Q9 triangles, the rest are acyclic
+LUBM_REFERENCE_SHAPES = {
+    "lubm_q1": LUBM_PREFIX + """SELECT ?X ?Y ?Z WHERE {
+        ?X rdf:type ub:GraduateStudent . ?Y rdf:type ub:University .
+        ?Z rdf:type ub:Department . ?X ub:memberOf ?Z .
+        ?Z ub:subOrganizationOf ?Y . ?X ub:undergraduateDegreeFrom ?Y . }""",
+    "lubm_q2": LUBM_PREFIX + """SELECT ?X ?Y ?Z WHERE {
+        ?X rdf:type ub:UndergraduateStudent . ?Y rdf:type ub:FullProfessor .
+        ?Z rdf:type ub:Course . ?X ub:advisor ?Y . ?Y ub:teacherOf ?Z .
+        ?X ub:takesCourse ?Z . }""",
+    "lubm_q3": LUBM_PREFIX + """SELECT ?X WHERE {
+        ?X rdf:type ub:GraduateStudent .
+        ?X ub:takesCourse
+        <http://www.Department0.University0.edu/GraduateCourse0> . }""",
+    "lubm_q4": LUBM_PREFIX + """SELECT ?X ?Y1 ?Y2 WHERE {
+        ?X ub:worksFor <http://www.Department0.University0.edu> .
+        ?X rdf:type ub:FullProfessor . ?X ub:name ?Y1 .
+        ?X ub:emailAddress ?Y2 . }""",
+    "lubm_q5": LUBM_PREFIX + """SELECT ?X WHERE {
+        ?X ub:memberOf <http://www.Department0.University0.edu> . }""",
+    "lubm_q6": LUBM_PREFIX + """SELECT ?X WHERE {
+        ?X rdf:type ub:GraduateStudent . }""",
+    "lubm_q7": LUBM_PREFIX + """SELECT ?X ?Y WHERE {
+        ?X rdf:type ub:UndergraduateStudent . ?Y rdf:type ub:Course .
+        <http://www.Department0.University0.edu/AssociateProfessor0>
+        ub:teacherOf ?Y . ?X ub:takesCourse ?Y . }""",
+}
+
+
+@pytest.fixture(scope="module")
+def lubm_world():
+    from wukong_tpu.loader.lubm import VirtualLubmStrings, generate_lubm
+    from wukong_tpu.store.gstore import build_partition
+
+    triples, _ = generate_lubm(1, seed=42)
+    g = build_partition(triples, 0, 1)
+    return g, VirtualLubmStrings(1, seed=42), Stats.generate(triples)
+
+
+def test_lubm_reference_queries_route_walk_under_auto(lubm_world):
+    """The acceptance guard: every LUBM reference shape — including the
+    two cyclic triangles, whose walk intermediates stay small — routes
+    ``walk`` under the default auto knobs, so the serving headline path
+    is untouched by the new strategy."""
+    from wukong_tpu.sparql.parser import Parser
+
+    g, ss, stats = lubm_world
+    pl = Planner(stats)
+    for name, text in LUBM_REFERENCE_SHAPES.items():
+        q = Parser(ss).parse(text)
+        pl.generate_plan(q)
+        assert pl.choose_strategy(q.pattern_group.patterns) == "walk", name
+
+
+def test_lubm_acyclic_wcoj_forced_still_identical(lubm_world):
+    """Forcing wcoj on a supported acyclic LUBM shape stays
+    byte-identical to the walk (strategy changes plans, never answers)."""
+    from wukong_tpu.sparql.parser import Parser
+
+    g, ss, stats = lubm_world
+    text = LUBM_REFERENCE_SHAPES["lubm_q5"]
+    qw = Parser(ss).parse(text)
+    heuristic_plan(qw)
+    CPUEngine(g, ss).execute(qw)
+    qj = Parser(ss).parse(text)
+    heuristic_plan(qj)
+    WCOJExecutor(g, ss, stats=stats).execute(qj)
+    assert qj.result.status_code == ErrorCode.SUCCESS
+    assert rows_of(qw) == rows_of(qj)
+
+
+# ---------------------------------------------------------------------------
+# proxy routing, degradation, chaos
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def tri_proxy():
+    from wukong_tpu.store.gstore import build_partition
+
+    triples, meta = generate_triangle(m=60, noise=3, seed=1)
+    g = build_partition(triples, 0, 1)
+    ss = CyclicStrings(meta)
+    stats = Stats.generate(triples)
+    proxy = Proxy(g, ss, cpu_engine=CPUEngine(g, ss), planner=Planner(stats))
+    return proxy, cyclic_query_text(meta)
+
+
+def test_proxy_auto_routes_wcoj_and_matches_walk(tri_proxy):
+    proxy, text = tri_proxy
+    Global.wcoj_min_rows = 1
+    Global.wcoj_ratio = 1
+    q = proxy.run_single_query(text, blind=False)
+    assert q.join_strategy == "wcoj"
+    assert q.result.status_code == ErrorCode.SUCCESS
+    Global.join_strategy = "walk"
+    qw = proxy.run_single_query(text, blind=False)
+    assert qw.join_strategy == "walk"
+    assert rows_of(q) == rows_of(qw)
+
+
+def test_proxy_strategy_memoized_and_knob_responsive(tri_proxy):
+    proxy, text = tri_proxy
+    Global.wcoj_min_rows = 1
+    Global.wcoj_ratio = 1
+    assert proxy.run_single_query(text).join_strategy == "wcoj"
+    # memoized decision must NOT outlive a knob flip (knobs join the key)
+    Global.join_strategy = "walk"
+    assert proxy.run_single_query(text).join_strategy == "walk"
+    Global.join_strategy = "auto"
+    assert proxy.run_single_query(text).join_strategy == "wcoj"
+
+
+@pytest.mark.chaos
+def test_join_materialize_fault_degrades_to_walk(tri_proxy):
+    """An injected ``join.materialize`` transient fires before any result
+    state is touched; the proxy re-dispatches the SAME query to the walk:
+    reply SUCCESS, rows byte-identical, fallback counted — never an
+    error."""
+    proxy, text = tri_proxy
+    Global.wcoj_min_rows = 1
+    Global.wcoj_ratio = 1
+    qw = proxy.run_single_query(text, blind=False)  # wcoj baseline
+    assert qw.join_strategy == "wcoj"
+    proxy.wcoj().tables.clear()
+    before = _fallbacks(proxy)
+    faults.install(FaultPlan(
+        [FaultSpec(site="join.materialize", kind="transient")], seed=7))
+    q = proxy.run_single_query(text, blind=False)
+    faults.clear()
+    assert q.result.status_code == ErrorCode.SUCCESS
+    assert q.result.complete
+    assert rows_of(q) == rows_of(qw)
+    assert _fallbacks(proxy) == before + 1
+
+
+def _fallbacks(proxy) -> float:
+    total = 0.0
+    for s in proxy.metrics.snapshot().get(
+            "wukong_join_fallback_total", {}).get("series", []):
+        total += s["value"]
+    return total
+
+
+def test_wcoj_budget_expiry_is_structured_partial(tri_proxy):
+    proxy, text = tri_proxy
+    Global.wcoj_min_rows = 1
+    Global.wcoj_ratio = 1
+    Global.query_budget_rows = 10
+    try:
+        q = proxy.run_single_query(text, blind=False)
+    finally:
+        Global.query_budget_rows = 0
+    assert q.join_strategy == "wcoj"
+    assert q.result.status_code == ErrorCode.BUDGET_EXCEEDED
+    assert not q.result.complete
+    assert q.result.dropped_patterns  # the unexecuted patterns are named
+
+
+def test_explain_renders_strategy_and_levels(tri_proxy):
+    proxy, text = tri_proxy
+    Global.wcoj_min_rows = 1
+    Global.wcoj_ratio = 1
+    rep = proxy.explain_query(text)
+    assert rep["strategy"] == "wcoj"
+    assert "strategy: wcoj" in rep["rendered"]
+    rep2 = proxy.explain_query(text, analyze=True)
+    assert rep2["strategy"] == "wcoj"
+    levels = rep2["wcoj_levels"]
+    assert len(levels) == 3  # one per variable
+    assert all({"var", "candidates", "rows_out", "probes"} <= set(lv)
+               for lv in levels)
+    assert "candidates" in rep2["rendered"]
+
+
+def test_table_cache_invalidates_on_store_version_bump(tri_proxy):
+    """A dynamic insert bumps the store version; the WCOJ sorted-table
+    cache is version-keyed, so the next query sees the new edge without
+    any explicit invalidation."""
+    from wukong_tpu.store.dynamic import insert_triples
+
+    proxy, text = tri_proxy
+    Global.join_strategy = "wcoj"
+    base = proxy.run_single_query(text, blind=False)
+    g = proxy.g
+    meta_p = {2: "p1", 3: "p2", 4: "p3"}
+    assert set(meta_p) == {2, 3, 4}
+    # close a brand-new triangle on fresh vertices
+    from wukong_tpu.types import NORMAL_ID_START
+
+    a, b, c = (NORMAL_ID_START + 7001, NORMAL_ID_START + 7002,
+               NORMAL_ID_START + 7003)
+    insert_triples(g, np.asarray(
+        [[a, 2, b], [b, 3, c], [a, 4, c]], dtype=np.int64))
+    q = proxy.run_single_query(text, blind=False)
+    assert q.result.status_code == ErrorCode.SUCCESS
+    assert (a, b, c) in rows_of(q)
+    assert rows_of(q) - rows_of(base) == {(a, b, c)}
+
+
+# ---------------------------------------------------------------------------
+# the join-strategy analysis gate
+# ---------------------------------------------------------------------------
+
+GATE_GOOD = """
+JOIN_STRATEGIES = ("walk", "wcoj")
+"""
+GATE_CHOOSER_OK = """
+def choose_strategy(patterns):
+    if not patterns:
+        return "walk"
+    return "wcoj"
+"""
+GATE_CHOOSER_BAD = """
+def choose_strategy(patterns):
+    return "wolk"
+"""
+
+
+def _write_tree(root, files):
+    for rel, src in files.items():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+    return str(root)
+
+
+def test_join_gate_clean_tree_passes(tmp_path):
+    from wukong_tpu.analysis import run_analysis
+
+    pkg = _write_tree(tmp_path / "pkg", {
+        "join/__init__.py": GATE_GOOD,
+        "planner/opt.py": GATE_CHOOSER_OK,
+    })
+    assert run_analysis(pkg, plugins=["join-strategy"]) == []
+
+
+def test_join_gate_flags_undeclared_strategy(tmp_path):
+    from wukong_tpu.analysis import run_analysis
+
+    pkg = _write_tree(tmp_path / "pkg", {
+        "join/__init__.py": GATE_GOOD,
+        "planner/opt.py": GATE_CHOOSER_BAD,
+    })
+    bad = run_analysis(pkg, plugins=["join-strategy"])
+    assert len(bad) == 1 and "wolk" in bad[0].message
+
+
+def test_join_gate_flags_missing_registry(tmp_path):
+    from wukong_tpu.analysis import run_analysis
+
+    pkg = _write_tree(tmp_path / "pkg", {
+        "join/__init__.py": "X = 1\n",
+    })
+    bad = run_analysis(pkg, plugins=["join-strategy"])
+    assert len(bad) == 1 and "JOIN_STRATEGIES" in bad[0].message
+
+
+def test_join_gate_requires_readme_knob_row(tmp_path):
+    from wukong_tpu.analysis import run_analysis
+
+    pkg = _write_tree(tmp_path / "pkg", {
+        "join/__init__.py": GATE_GOOD,
+    })
+    readme = tmp_path / "README.md"
+    readme.write_text("| knob | default |\n|---|---|\n| `other` | x |\n")
+    bad = run_analysis(pkg, plugins=["join-strategy"],
+                       readme_path=str(readme))
+    assert len(bad) == 1 and "join_strategy" in bad[0].message
+    readme.write_text(
+        "| knob | default |\n|---|---|\n| `join_strategy` | auto |\n")
+    assert run_analysis(pkg, plugins=["join-strategy"],
+                        readme_path=str(readme)) == []

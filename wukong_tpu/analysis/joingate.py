@@ -1,0 +1,102 @@
+"""join-strategy gate: execution-strategy outcomes stay a closed set.
+
+The planner's ``choose_strategy`` (and any future strategy chooser) routes
+every query to exactly one execution strategy. A typo'd or undeclared
+strategy string would silently mis-route queries — the proxy would fall
+through to the walk and the wcoj path would never fire, with no error
+anywhere. This gate holds three invariants statically:
+
+- ``wukong_tpu/join/__init__.py`` declares the literal
+  ``JOIN_STRATEGIES`` registry;
+- every string-literal ``return`` inside any function named
+  ``choose_strategy``/``classify_join_strategy`` is a declared strategy;
+- the ``join_strategy`` knob is documented in a README knob table (the
+  config-readme gate checks existence of the field doc; this one pins the
+  operator-facing table row the ISSUE requires).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from wukong_tpu.analysis.drift import _table_cells
+from wukong_tpu.analysis.framework import (
+    AnalysisPlugin,
+    RepoContext,
+    Violation,
+    register,
+)
+
+JOIN_MODULE = "join/__init__.py"
+REGISTRY_NAME = "JOIN_STRATEGIES"
+#: functions whose string-literal returns must be declared strategies
+CHOOSER_NAMES = ("choose_strategy", "classify_join_strategy")
+
+
+def _registry(ctx: RepoContext):
+    """(strategies, lineno) from the literal JOIN_STRATEGIES assignment."""
+    if JOIN_MODULE not in ctx.paths():
+        return None, 0
+    sf = ctx.file(JOIN_MODULE)
+    if sf.tree is None:
+        return None, 0
+    for st in sf.tree.body:
+        tgt = st.targets[0] if isinstance(st, ast.Assign) else (
+            st.target if isinstance(st, ast.AnnAssign) else None)
+        if isinstance(tgt, ast.Name) and tgt.id == REGISTRY_NAME:
+            names = set()
+            for n in ast.walk(st):
+                if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                    names.add(n.value)
+            return names, st.lineno
+    return None, 0
+
+
+@register
+class JoinStrategyGate(AnalysisPlugin):
+    name = "join-strategy"
+    description = ("strategy-chooser outcomes are declared JOIN_STRATEGIES "
+                   "members and the join_strategy knob row exists in README")
+
+    def run(self, ctx: RepoContext) -> list[Violation]:
+        if JOIN_MODULE not in ctx.paths():
+            return []  # tree without a join subsystem: nothing to check
+        declared, reg_line = self._declared(ctx)
+        if declared is None:
+            return [Violation(self.name, JOIN_MODULE, 1,
+                              f"no literal {REGISTRY_NAME} registry found — "
+                              "declare every execution strategy centrally")]
+        out: list[Violation] = []
+        for sf in ctx.iter_files():
+            if sf.tree is None:
+                continue
+            for node in ast.walk(sf.tree):
+                if not (isinstance(node, ast.FunctionDef)
+                        and node.name in CHOOSER_NAMES):
+                    continue
+                for ret in ast.walk(node):
+                    if not isinstance(ret, ast.Return):
+                        continue
+                    val = ret.value
+                    if (isinstance(val, ast.Constant)
+                            and isinstance(val.value, str)
+                            and val.value not in declared):
+                        out.append(Violation(
+                            self.name, sf.rel, ret.lineno,
+                            f"{node.name}() returns {val.value!r} which is "
+                            f"not declared in {JOIN_MODULE}::"
+                            f"{REGISTRY_NAME}"))
+        readme = ctx.readme_text()
+        if readme is not None:
+            knob_rows = {part.strip().strip("`")
+                         for tok, _ln in _table_cells(readme, "knob")
+                         for part in tok.split("/")}
+            if "join_strategy" not in knob_rows:
+                out.append(Violation(
+                    self.name, "", reg_line,
+                    "README has no knob-table row for `join_strategy` — "
+                    "the strategy knob must be operator-documented"))
+        return out
+
+    def _declared(self, ctx: RepoContext):
+        return _registry(ctx)

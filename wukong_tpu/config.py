@@ -228,6 +228,27 @@ class GlobalConfig:
     # classified heavy even without an index-origin start
     heavy_rows_threshold: int = 100000
 
+    # ---- tensor-join (WCOJ) execution knobs (wukong_tpu/join/; all
+    # mutable). The planner picks an execution strategy per query:
+    # the expand-per-step walk, or the worst-case-optimal level-at-a-time
+    # join for cyclic/analytic shapes whose walk intermediates blow up. ----
+    # strategy selection: auto (planner chooses from the estimated
+    # intermediate-vs-fragment cardinality ratio; acyclic queries always
+    # walk), walk (force the walk), wcoj (force the tensor join on every
+    # supported shape)
+    join_strategy: str = "auto"
+    # auto routes wcoj when the walk's estimated peak intermediate rows
+    # reach this multiple of the estimated final fragment size (the
+    # wedge-blowup signature); below it the walk's simpler kernels win
+    wcoj_ratio: int = 4
+    # auto additionally requires the estimated peak to reach this many
+    # rows: a blowup measured in thousands is cheaper to walk through
+    # than to pay the per-level intersection overhead for
+    wcoj_min_rows: int = 8192
+    # bounded cache of materialized sorted edge tables / index lists
+    # (entries, keyed per store version like the plan cache)
+    join_table_cache: int = 64
+
     # ---- TPU-engine knobs (new; no reference analogue) ----
     table_capacity_min: int = 1024  # smallest binding-table capacity class
     # largest capacity class: 32M rows x 8 cols x int32 = 1 GiB, within one

@@ -1,0 +1,40 @@
+"""Worst-case-optimal tensor-join execution (the second execution strategy).
+
+The expand-per-BGP-step walk (CPUEngine/TPUEngine) explodes on cyclic
+patterns — a triangle query first materializes the full wedge set before the
+closing membership filter prunes it, so intermediates grow as the product of
+edge fanouts. Worst-case-optimal joins (Leapfrog Triejoin / generic join;
+EmptyHeaded, TrieJax — PAPERS.md) bound intermediates by the AGM fragment
+size instead: variables are materialized one at a time in a global
+elimination order, and every pattern incident on the new variable constrains
+its candidate set *at that level* via sorted-array intersection, never after
+a blowup.
+
+Layout:
+
+- ``qgraph.py``  — query-graph analyzer: cyclicity detection over the
+  variable join graph + the generic-join variable elimination order derived
+  from the optimizer's type-centric cardinality stats.
+- ``kernels.py`` — sorted-array primitives (vectorized binary search,
+  sorted-set membership, ragged pair probes) written against a swappable
+  array module so the same code runs as NumPy on the host and JIT-compiles
+  under XLA.
+- ``wcoj.py``    — the executor: per-(predicate, direction) sorted edge
+  tables materialized from the gstore CSR segments (cached per store
+  version, like the plan cache), walked level-at-a-time.
+
+The planner selects the strategy per query (``Planner.choose_strategy``,
+``join_strategy`` knob: ``auto``/``walk``/``wcoj``); every outcome must be a
+member of :data:`JOIN_STRATEGIES` — the ``join-strategy`` analysis gate
+enforces this statically.
+"""
+
+from __future__ import annotations
+
+#: THE closed set of execution strategies the planner may choose between.
+#: The ``join-strategy`` analysis gate checks every ``choose_strategy``
+#: return against this literal registry, so a typo'd strategy name is a
+#: build failure, not a silent mis-route.
+JOIN_STRATEGIES = ("walk", "wcoj")
+
+__all__ = ["JOIN_STRATEGIES"]

@@ -1,0 +1,126 @@
+"""Sorted-array join primitives for the WCOJ executor.
+
+Every kernel is written against a swappable array module ``xp`` (NumPy by
+default): the control flow is branch-free with statically-bounded loops, so
+the SAME functions trace and JIT-compile under XLA with ``xp=jax.numpy``
+(TrieJax's observation that LFTJ's per-level work is sorted search +
+gather — exactly what an accelerator's vector unit wants). The host path
+runs them as plain NumPy; the device path wraps them in ``jax.jit``.
+
+Data model: adjacency is the store's CSR triplet (sorted unique ``keys``,
+``offsets``, ``edges`` sorted within each key run); candidate sets are
+sorted 1-D id arrays. Intersection = membership mask via vectorized binary
+search; ragged per-row probes = fixed-iteration branchless lower_bound over
+each row's [start, end) edge range.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def member_sorted(sorted_arr, vals, xp=np):
+    """Boolean mask: is ``vals[i]`` present in ``sorted_arr``?
+
+    One vectorized binary search (searchsorted lowers to XLA's sort-based
+    search under jit) + one gather. Empty set -> all-False.
+    """
+    n = int(sorted_arr.shape[0])
+    if n == 0:
+        return xp.zeros(vals.shape[0], dtype=bool)
+    idx = xp.searchsorted(sorted_arr, vals)
+    idx_c = xp.clip(idx, 0, n - 1)
+    return (idx < n) & (sorted_arr[idx_c] == vals)
+
+
+def intersect_sorted(a, b, xp=np):
+    """Sorted intersection of two sorted unique arrays (result stays
+    sorted/unique). The smaller side should be ``a`` — the probe cost is
+    ``|a| * log |b|``."""
+    if a.shape[0] == 0 or b.shape[0] == 0:
+        return a[:0]
+    return a[member_sorted(b, a, xp=xp)]
+
+
+def intersect_many(lists, xp=np):
+    """Fold-intersect sorted unique arrays, smallest first (leapfrog's
+    seek-from-the-shortest-list order). Empty input list -> None."""
+    if not lists:
+        return None
+    out = None
+    for arr in sorted(lists, key=lambda t: t.shape[0]):
+        out = arr if out is None else intersect_sorted(out, arr, xp=xp)
+        if out.shape[0] == 0:
+            break
+    return out
+
+
+def lookup_ranges(keys, offsets, vids, xp=np):
+    """(start, degree) of each vid's edge range in a CSR (0 when absent)."""
+    n = int(keys.shape[0])
+    if n == 0:
+        z = xp.zeros(vids.shape[0], dtype=np.int64)
+        return z, z
+    idx = xp.searchsorted(keys, vids)
+    idx_c = xp.clip(idx, 0, n - 1)
+    found = (idx < n) & (keys[idx_c] == vids)
+    start = xp.where(found, offsets[idx_c], 0)
+    deg = xp.where(found, offsets[idx_c + 1] - offsets[idx_c], 0)
+    return start, deg
+
+
+def expand_ragged(start: np.ndarray, deg: np.ndarray):
+    """(row_idx, flat edge positions) for a ragged per-row expansion.
+
+    deg=[2,0,3] -> row_idx=[0,0,2,2,2], pos=[s0,s0+1,s2,s2+1,s2+2]
+    (row indices are ORIGINAL positions — zero-degree rows are skipped,
+    never compacted away, so callers may index anchors with row_idx).
+    Host-side only (the output length is data-dependent — the device path
+    pads to a capacity class instead, like the engine's expand kernels).
+    """
+    row_idx = np.repeat(np.arange(len(deg)), deg)
+    total = int(deg.sum())
+    local = np.ones(total, dtype=np.int64)
+    if total:
+        starts = np.concatenate([[0], np.cumsum(deg)[:-1]])
+        nz = deg > 0
+        local[starts[nz]] = np.concatenate([[0], 1 - deg[nz][:-1]])
+        local = np.cumsum(local)
+    return row_idx, start[row_idx] + local
+
+
+def pair_member(keys, offsets, edges, anchors, vals, xp=np):
+    """Boolean mask: does edge (anchors[i] -> vals[i]) exist in the CSR?
+
+    Branchless lower_bound over each row's sorted [start, end) edge range,
+    iterated a FIXED ``log2(len(edges))+1`` times so the loop unrolls
+    statically under XLA tracing (the host pays the same bound — a no-op
+    once every row's range has converged).
+    """
+    ne = int(edges.shape[0])
+    if ne == 0:
+        return xp.zeros(anchors.shape[0], dtype=bool)
+    start, deg = lookup_ranges(keys, offsets, anchors, xp=xp)
+    lo = start.astype(np.int64)
+    end = (start + deg).astype(np.int64)
+    hi = end
+    for _ in range(ne.bit_length() + 1):
+        active = lo < hi
+        mid = (lo + hi) // 2
+        mv = edges[xp.clip(mid, 0, ne - 1)]
+        less = mv < vals
+        lo = xp.where(active & less, mid + 1, lo)
+        hi = xp.where(active & ~less, mid, hi)
+    inb = lo < end
+    return inb & (edges[xp.clip(lo, 0, ne - 1)] == vals)
+
+
+def jit_kernels():
+    """jax.jit-wrapped (member_sorted, pair_member) over jax.numpy — the
+    XLA path. Imported lazily so the NumPy fallback never touches jax."""
+    import jax
+    import jax.numpy as jnp
+
+    member = jax.jit(lambda s, v: member_sorted(s, v, xp=jnp))
+    pair = jax.jit(lambda k, o, e, a, v: pair_member(k, o, e, a, v, xp=jnp))
+    return member, pair

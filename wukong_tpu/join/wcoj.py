@@ -1,0 +1,380 @@
+"""Leapfrog-Triejoin-style worst-case-optimal executor (the ``wcoj`` strategy).
+
+Executes a planned BGP level-at-a-time in the query graph's variable
+elimination order (qgraph.py): each level materializes ONE variable, with
+every incident pattern constraining the candidate set *at that level* —
+per-row adjacency expansion from the cheapest bound anchor, sorted-set
+intersection of the global candidate lists (type/predicate indexes, const
+neighbor lists), and ragged binary-search probes for the remaining bound
+edges. Intermediates are therefore bounded by the join's fragment size, not
+by the walk's wedge blowup (EmptyHeaded/TrieJax, PAPERS.md).
+
+Edge tables are the store's own CSR segments, verified-sorted once and
+cached per store version (:class:`JoinTableCache`, the plan-cache pattern:
+a dynamic insert / stream commit bumps the version and stale entries become
+unreachable). Materialization is a ``join.materialize`` fault site — an
+injected failure surfaces BEFORE the query result is touched, so the proxy
+degrades the query to the walk, never to an error.
+
+Resilience parity with the walk: the per-query deadline is checked and the
+row budget charged at every level; expiry commits the prefix built so far
+as a structured partial result (``result.complete = False``).
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import numpy as np
+
+from wukong_tpu.analysis.lockdep import declare_leaf, make_lock
+from wukong_tpu.config import Global
+from wukong_tpu.join.kernels import (
+    expand_ragged,
+    intersect_many,
+    lookup_ranges,
+    member_sorted,
+    pair_member,
+)
+from wukong_tpu.join.qgraph import U_CONST, U_PINDEX, U_TYPE, analyze
+from wukong_tpu.obs.metrics import get_registry
+from wukong_tpu.obs.trace import traced_execute
+from wukong_tpu.runtime import faults
+from wukong_tpu.runtime.resilience import (
+    charge_query,
+    check_query,
+    mark_partial,
+)
+from wukong_tpu.store.segment import CSRSegment
+from wukong_tpu.types import IN, OUT
+from wukong_tpu.utils.errors import (
+    BudgetExceeded,
+    ErrorCode,
+    QueryTimeout,
+    WukongError,
+)
+from wukong_tpu.utils.timer import get_usec
+
+_M_MATERIALIZE = get_registry().counter(
+    "wukong_join_materialize_total",
+    "WCOJ sorted-edge-table cache requests", labels=("outcome",))
+
+# the cache lock guards pure dict moves (materialization happens outside
+# it); nothing is ever acquired under it
+declare_leaf("join.tables")
+
+
+def _verify_sorted_segment(seg: CSRSegment) -> CSRSegment:
+    """Return ``seg`` with edges guaranteed sorted within each key run.
+
+    CSR builders emit this invariant already; a defensive verify keeps the
+    probe kernels' binary-search contract independent of future store
+    writers. O(E) check, re-sort only on violation.
+    """
+    e, off = seg.edges, seg.offsets
+    if len(e) > 1:
+        inc = e[1:] >= e[:-1]
+        inc[off[1:-1] - 1] = True  # run boundaries may descend
+        if not bool(inc.all()):
+            keys = np.repeat(seg.keys, np.diff(off))
+            order = np.lexsort((e, keys))
+            return CSRSegment.from_sorted_pairs(keys[order], e[order])
+    return seg
+
+
+def _sorted_index(arr) -> np.ndarray:
+    a = np.asarray(arr, dtype=np.int64)
+    if len(a) > 1 and not bool((a[1:] >= a[:-1]).all()):
+        a = np.unique(a)
+    return a
+
+
+class JoinTableCache:
+    """Per-store cache of verified-sorted edge tables and index lists.
+
+    Keys carry the store version, so mutations (dynamic inserts, stream
+    commits) make stale entries unreachable — the plan-cache invalidation
+    pattern. Bounded LRU of ``join_table_cache`` entries. Materialization
+    (the verify/re-sort pass) runs OUTSIDE the lock behind the
+    ``join.materialize`` fault site; a duplicate concurrent build is
+    idempotent and the second writer simply refreshes the entry.
+    """
+
+    def __init__(self, gstore):
+        self.g = gstore
+        self._tables: OrderedDict = OrderedDict()  # guarded by: _lock
+        self._lock = make_lock("join.tables")
+
+    def _version(self) -> int:
+        return int(getattr(self.g, "version", 0))
+
+    def _get(self, key):
+        with self._lock:
+            v = self._tables.get(key)
+            if v is not None:
+                self._tables.move_to_end(key)
+            return v
+
+    def _put(self, key, value):
+        with self._lock:
+            self._tables[key] = value
+            self._tables.move_to_end(key)
+            cap = max(int(Global.join_table_cache), 1)
+            while len(self._tables) > cap:
+                self._tables.popitem(last=False)
+            return value
+
+    def segment(self, pid: int, d: int) -> CSRSegment:
+        """The (pid, dir) adjacency as a verified-sorted CSR segment."""
+        key = (self._version(), "seg", int(pid), int(d))
+        hit = self._get(key)
+        if hit is not None:
+            _M_MATERIALIZE.labels(outcome="hit").inc()
+            return hit
+        _M_MATERIALIZE.labels(outcome="miss").inc()
+        faults.site("join.materialize")
+        seg = self.g.segments.get((int(pid), int(d)))
+        seg = (CSRSegment.empty() if seg is None
+               else _verify_sorted_segment(seg))
+        return self._put(key, seg)
+
+    def index_list(self, tpid: int, d: int) -> np.ndarray:
+        """A type/predicate index as a sorted unique id array."""
+        key = (self._version(), "idx", int(tpid), int(d))
+        hit = self._get(key)
+        if hit is not None:
+            _M_MATERIALIZE.labels(outcome="hit").inc()
+            return hit
+        _M_MATERIALIZE.labels(outcome="miss").inc()
+        faults.site("join.materialize")
+        return self._put(key, _sorted_index(self.g.get_index(tpid, d)))
+
+    def neighbor_list(self, const: int, pid: int, d: int) -> np.ndarray:
+        """One constant's neighbor list (sorted — a CSR edge run)."""
+        # uncached: the segment lookup is already one binary search, and
+        # per-const keys would churn the bounded cache under template mixes
+        return np.asarray(self.segment(pid, d).lookup(const), dtype=np.int64)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._tables.clear()
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"entries": len(self._tables)}
+
+
+class WCOJExecutor:
+    """Worst-case-optimal BGP execution over one (host) partition.
+
+    ``stats`` (the optimizer's type-centric statistics) refines the
+    variable elimination order; without it the analyzer falls back to
+    structural heuristics. FILTER evaluation and final processing are
+    delegated to the CPU engine's stages so string/DISTINCT/ORDER semantics
+    can never drift between strategies.
+    """
+
+    def __init__(self, gstore, str_server=None, stats=None):
+        self.g = gstore
+        self.str_server = str_server
+        self.stats = stats
+        self.tables = JoinTableCache(gstore)
+
+    # ------------------------------------------------------------------
+    def execute(self, q, from_proxy: bool = True):
+        """Engine-contract execution: failures land as reply status codes,
+        never as raised WukongErrors (CPUEngine parity)."""
+        try:
+            return self.try_execute(q, from_proxy)
+        except WukongError as e:
+            q.result.status_code = e.code
+            return q
+
+    def try_execute(self, q, from_proxy: bool = True):
+        """Degradable execution: a failure in the join phase RAISES with
+        ``q`` untouched, so the caller (the proxy's strategy router) can
+        re-dispatch the same query to the walk. Structured deadline/budget
+        expiry still commits a partial result, and a FILTER/FINAL-stage
+        failure after the join committed sets the reply status (those are
+        query-semantic — the walk would fail them identically)."""
+        return traced_execute(
+            q, "wcoj.execute", lambda: self._try_impl(q, from_proxy),
+            lambda: {"rows": q.result.nrows,
+                     "status": q.result.status_code.name})
+
+    def _try_impl(self, q, from_proxy: bool):
+        try:
+            self.run_bgp(q)
+        except (QueryTimeout, BudgetExceeded) as e:
+            mark_partial(q, e)
+            return q
+        try:
+            if q.pattern_group.filters:
+                self._cpu()._execute_filters(q)
+            if from_proxy:
+                self._cpu()._final_process(q)
+        except (QueryTimeout, BudgetExceeded) as e:
+            mark_partial(q, e)
+        except WukongError as e:
+            q.result.status_code = e.code
+        return q
+
+    def _cpu(self):
+        from wukong_tpu.engine.cpu import CPUEngine
+
+        return CPUEngine(self.g, self.str_server)
+
+    # ------------------------------------------------------------------
+    def run_bgp(self, q) -> None:
+        """Generic join over the BGP. Commits into ``q.result`` only on
+        success or on a structured deadline/budget expiry (partial prefix);
+        any other failure leaves ``q`` untouched so the caller can degrade
+        to the walk."""
+        pg = q.pattern_group
+        if pg.unions or pg.optional:
+            raise WukongError(ErrorCode.UNSUPPORTED_SHAPE,
+                              "wcoj executes plain BGPs (UNION/OPTIONAL "
+                              "route walk)")
+        qg = analyze(pg.patterns, stats=self.stats)
+        if not qg.supported:
+            raise WukongError(ErrorCode.UNSUPPORTED_SHAPE,
+                              f"wcoj: {qg.reason}")
+
+        # resolve every constraint's backing array up-front: the
+        # join.materialize fault site fires here, before q is touched
+        unary_lists: dict[int, list] = {v: [] for v in qg.order}
+        for u in qg.unaries:
+            if u.kind == U_TYPE:
+                arr = self.tables.index_list(u.payload, IN)
+            elif u.kind == U_PINDEX:
+                arr = self.tables.index_list(*u.payload)
+            else:  # U_CONST
+                arr = self.tables.neighbor_list(*u.payload)
+            unary_lists[u.var].append(arr)
+        # each edge is consumed exactly once as an adjacency (anchored on
+        # the endpoint materialized FIRST, expanding/probing the later
+        # one) and once as the earlier endpoint's index list — warm only
+        # those, so _level's lazy fetches are guaranteed cache hits and
+        # no fault can fire past this point
+        pos = {v: i for i, v in enumerate(qg.order)}
+        for e in qg.edges:
+            later_is_o = pos[e.o] > pos[e.s]
+            self.tables.segment(e.pid, OUT if later_is_o else IN)
+            earlier = e.s if later_is_o else e.o
+            self.tables.index_list(e.pid, IN if earlier == e.s else OUT)
+
+        prefix = np.empty((1, 0), dtype=np.int64)
+        cols: dict[int, int] = {}
+        levels: list[dict] = []
+        try:
+            for k, v in enumerate(qg.order):
+                check_query(q, f"wcoj.level {k}")
+                t0 = get_usec()
+                rows_in = len(prefix)
+                prefix, rec = self._level(qg, v, k, prefix, cols,
+                                          unary_lists[v])
+                cols[v] = k
+                rec.update(level=k, var=v, rows_in=rows_in,
+                           rows_out=len(prefix),
+                           time_us=get_usec() - t0)
+                levels.append(rec)
+                charge_query(q, len(prefix), f"wcoj.level {k}")
+        except (QueryTimeout, BudgetExceeded):
+            # structured degradation: commit the prefix built so far as a
+            # partial result (mark_partial lists every pattern dropped)
+            self._commit(q, prefix, cols, levels, partial=True)
+            raise
+        self._commit(q, prefix, cols, levels, partial=False)
+
+    # ------------------------------------------------------------------
+    def _level(self, qg, v: int, k: int, prefix: np.ndarray,
+               cols: dict, unary: list):
+        """Materialize variable ``v`` against the bound prefix.
+
+        Generator choice is PER ROW: each prefix row expands from its
+        smallest incident candidate list (the cheapest bound adjacency, or
+        the intersected global list) — the leapfrog property that bounds
+        total candidates by the sum of per-row minimum degrees, which a
+        single per-level generator would lose on skewed (hub) data. Every
+        constraint then filters all candidates (the generating list's
+        self-probe is redundant but always true). Returns the new prefix
+        and the level's intersection stats.
+        """
+        adj = []  # (anchor col, segment) — other endpoint already bound
+        glob = list(unary)  # global sorted candidate lists
+        for e in qg.edges_of(v):
+            v_is_o = e.o == v
+            other = e.s if v_is_o else e.o
+            if other in cols:
+                seg = self.tables.segment(e.pid, OUT if v_is_o else IN)
+                adj.append((cols[other], seg))
+            else:
+                glob.append(self.tables.index_list(
+                    e.pid, IN if e.s == v else OUT))
+        G = intersect_many(glob)
+        n = len(prefix)
+        if not adj and G is None:
+            raise WukongError(ErrorCode.UNSUPPORTED_SHAPE,
+                              f"wcoj: variable {v} has no constraint to "
+                              "generate candidates from")
+
+        # per-row generator: argmin over each adjacency's degree and the
+        # global list's (constant) length
+        ranges = [lookup_ranges(seg.keys, seg.offsets, prefix[:, c])
+                  for c, seg in adj]
+        deg_stack = [d for (_s, d) in ranges]
+        if G is not None:
+            deg_stack.append(np.full(n, len(G), dtype=np.int64))
+        degs = np.stack(deg_stack) if n else \
+            np.empty((len(deg_stack), 0), dtype=np.int64)
+        choice = np.argmin(degs, axis=0) if n else \
+            np.empty(0, dtype=np.int64)
+
+        parts = []  # (row_idx, newcol) per generator group
+        for j, (start, deg) in enumerate(ranges):
+            rows = np.nonzero(choice == j)[0]
+            if len(rows) == 0:
+                continue
+            row_idx, pos = expand_ragged(start[rows], deg[rows])
+            parts.append((rows[row_idx], adj[j][1].edges[pos]))
+        if G is not None:
+            rows = np.nonzero(choice == len(ranges))[0]
+            if len(rows):
+                parts.append((np.repeat(rows, len(G)),
+                              np.tile(G, len(rows))))
+        if parts:
+            row_idx = np.concatenate([p[0] for p in parts])
+            newcol = np.concatenate([p[1] for p in parts]).astype(
+                np.int64, copy=False)
+        else:
+            row_idx = np.empty(0, dtype=np.int64)
+            newcol = np.empty(0, dtype=np.int64)
+
+        candidates = len(newcol)
+        probes = 0
+        if len(newcol):
+            mask = np.ones(len(newcol), dtype=bool)
+            if G is not None:
+                probes += 1
+                mask &= member_sorted(G, newcol)
+            for c, seg in adj:
+                probes += 1
+                anchors = prefix[row_idx, c]
+                mask &= pair_member(seg.keys, seg.offsets, seg.edges,
+                                    anchors, newcol)
+            row_idx, newcol = row_idx[mask], newcol[mask]
+        new_prefix = np.column_stack(
+            [prefix[row_idx], newcol]).astype(np.int64, copy=False)
+        return new_prefix, {"candidates": candidates, "probes": probes}
+
+    # ------------------------------------------------------------------
+    def _commit(self, q, prefix: np.ndarray, cols: dict, levels: list,
+                partial: bool) -> None:
+        res = q.result
+        res.set_table(prefix)
+        res.col_num = prefix.shape[1]
+        for v, c in cols.items():
+            res.add_var2col(v, c)
+        q.join_stats = levels
+        if not partial:
+            q.pattern_step = len(q.pattern_group.patterns)

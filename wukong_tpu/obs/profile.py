@@ -46,7 +46,8 @@ from wukong_tpu.utils.timer import get_usec
 COMPONENTS = ("queue", "parse", "plan", "execute", "fetch")
 
 #: top-level engine execution spans (one per engine family)
-EXECUTE_SPANS = frozenset({"cpu.execute", "tpu.execute", "dist.execute"})
+EXECUTE_SPANS = frozenset({"cpu.execute", "tpu.execute", "dist.execute",
+                           "wcoj.execute"})
 
 #: per-BGP-step spans carrying step index + rows in/out attributes
 STEP_SPANS = frozenset({"cpu.step", "tpu.host_step"})
@@ -226,10 +227,16 @@ def _build_report(q, est: list | None, trace: QueryTrace | None,
         "query": " ".join(text.split())[:200],
         "planner": ("cost-based" if est is not None else "heuristic/none"),
         "planner_empty": bool(getattr(q, "planner_empty", False)),
+        "strategy": getattr(q, "join_strategy", "walk"),
         "steps": steps,
         "unions": len(q.pattern_group.unions),
         "optional": len(q.pattern_group.optional),
     }
+    # tensor-join execution: per-level intersection stats recorded by the
+    # WCOJ executor (variable order, candidate/emitted rows, probe counts)
+    join_stats = getattr(q, "join_stats", None)
+    if join_stats:
+        report["wcoj_levels"] = join_stats
     if est is not None:
         report["est_total_cost"] = round(est[-1]["est_cost_cum"], 1)
     if trace is not None:
@@ -269,7 +276,7 @@ def _render(report: dict) -> str:
                     f" {_n(rec.get('time_us')):>9}"
                     f" {_n(rec.get('fetches')):>5}")
         lines.append(row)
-    tail = f"planner: {report['planner']}"
+    tail = f"planner: {report['planner']}, strategy: {report['strategy']}"
     if "est_total_cost" in report:
         tail += f", est total cost {report['est_total_cost']:,}"
     if report["planner_empty"]:
@@ -279,6 +286,15 @@ def _render(report: dict) -> str:
                  f"{report['optional']} optional group(s), planned "
                  "recursively — not estimated here)")
     lines.append(tail)
+    if report.get("wcoj_levels"):
+        lines.append(f"{'lvl':>4}  {'var':>6} {'rows_in':>9} "
+                     f"{'candidates':>11} {'rows_out':>9} {'probes':>6} "
+                     f"{'time_us':>9}")
+        for lv in report["wcoj_levels"]:
+            lines.append(f"{lv['level']:>4}  {lv['var']:>6} "
+                         f"{lv['rows_in']:>9,} {lv['candidates']:>11,} "
+                         f"{lv['rows_out']:>9,} {lv['probes']:>6} "
+                         f"{lv.get('time_us', 0):>9,}")
     if analyze:
         lines.append(f"status: {report['status']} rows={report['rows']:,} "
                      f"complete={report['complete']} "

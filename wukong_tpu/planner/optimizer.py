@@ -486,6 +486,45 @@ class Planner:
             prev_cost = st.cost
         return out
 
+    # ------------------------------------------------------------------
+    # execution-strategy selection (wukong_tpu/join/): walk vs wcoj
+    # ------------------------------------------------------------------
+    def choose_strategy(self, patterns: list) -> str:
+        """Pick the execution strategy for an ALREADY-ORDERED pattern list.
+
+        ``join_strategy`` knob: ``walk`` forces the walk; ``wcoj`` forces
+        the tensor join on every supported shape; ``auto`` (default) routes
+        wcoj only when the query graph is cyclic AND the walk's estimated
+        peak intermediate cardinality reaches ``wcoj_ratio`` times the
+        estimated final fragment size — the wedge-blowup signature that
+        worst-case-optimal joins exist to avoid. Acyclic queries always
+        walk under auto (their intermediates are already near-fragment).
+        Every return value is a member of ``join.JOIN_STRATEGIES`` (the
+        ``join-strategy`` analysis gate holds this statically).
+        """
+        from wukong_tpu.config import Global
+        from wukong_tpu.join.qgraph import analyze
+
+        knob = str(Global.join_strategy).strip().lower()
+        if knob == "walk":
+            return "walk"
+        qg = analyze(patterns, stats=self.stats)
+        if not qg.supported:
+            return "walk"
+        if knob == "wcoj":
+            return "wcoj"
+        if not qg.cyclic:
+            return "walk"
+        ests = self.estimate_chain(patterns)
+        if ests is None:
+            # cyclic but unestimable: the walk's blowup is the known risk
+            return "wcoj"
+        peak, final = max(ests), max(ests[-1], 1.0)
+        if (peak >= max(int(Global.wcoj_min_rows), 1)
+                and peak / final >= max(float(Global.wcoj_ratio), 1.0)):
+            return "wcoj"
+        return "walk"
+
     def _orient(self, state: _State, p: Pattern) -> Pattern:
         s_var_b = p.subject < 0 and p.subject in state.vars
         pred_var = p.predicate < 0

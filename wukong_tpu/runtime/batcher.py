@@ -116,6 +116,12 @@ _M_HEAVY_FALLBACK = get_registry().counter(
 _M_HEAVY_OCC = get_registry().histogram(
     "wukong_batch_heavy_occupancy", "Heavy group size at flush",
     buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024))
+# split-vs-no-split decisions per fused heavy dispatch: the observable
+# behind heavy_split_threshold tuning (bench.py --serve-mixed prints the
+# counts so the threshold can be re-tuned against real worlds)
+_M_HEAVY_SPLIT = get_registry().counter(
+    "wukong_batch_heavy_split_total",
+    "Fused heavy dispatch split decisions", labels=("decision",))
 
 
 # ---------------------------------------------------------------------------
@@ -857,6 +863,9 @@ class HeavyGroup(FusedGroup):
             if m.trace is not None:
                 m.trace.event("batch.dispatch", group=gid, size=B,
                               reason=self.reason, lane="heavy")
+
+        _M_HEAVY_SPLIT.labels(
+            decision="split" if S > 1 else "no_split").inc()
 
         def dispatch() -> int:
             if S > 1:

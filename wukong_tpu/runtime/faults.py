@@ -28,6 +28,9 @@ Sites instrumented today:
 - ``replica.fetch``     — failover fetch from a shard replica in
   parallel/sharded_store.py (``shard`` = the replica HOST id)
 - ``checkpoint.write``  — checkpoint bundle write in runtime/recovery.py
+- ``join.materialize``  — WCOJ sorted-edge-table materialization in
+  join/wcoj.py (fires before any result state is touched, so the proxy
+  degrades the query to the walk instead of erroring)
 
 When no plan is installed every hook is a cheap no-op.
 """
@@ -58,6 +61,7 @@ KNOWN_FAULT_SITES = frozenset({
     "replica.fetch",       # failover replica fetch (sharded_store)
     "checkpoint.write",    # checkpoint bundle write (runtime/recovery.py)
     "batch.heavy.dispatch",  # fused heavy-lane dispatch (runtime/batcher.py)
+    "join.materialize",    # WCOJ sorted-table materialization (join/wcoj.py)
 })
 
 

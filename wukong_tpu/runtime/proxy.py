@@ -82,6 +82,15 @@ class Proxy:
         self._m_lane = self.metrics.counter(
             "wukong_lane_routed_total",
             "Plan-time light/heavy lane routing decisions", labels=("lane",))
+        # tensor-join strategy routing (wukong_tpu/join/): per-query
+        # strategy decisions and wcoj-to-walk degradations
+        self._m_join = self.metrics.counter(
+            "wukong_join_queries_total",
+            "Plan-time execution-strategy decisions", labels=("strategy",))
+        self._m_join_fallback = self.metrics.counter(
+            "wukong_join_fallback_total",
+            "WCOJ executions degraded to the walk", labels=("reason",))
+        self._wcoj = None  # guarded by: _batcher_init_lock
         self._pool = None
         self._stream = None
         # serving fast path: parse cache (query text -> parsed query) and
@@ -322,6 +331,53 @@ class Proxy:
         self._plan(qq, plan_text)
         qq.lane = self.classify_lane(qq)
         self._m_lane.labels(lane=qq.lane).inc()
+        qq.join_strategy = self.classify_join_strategy(qq)
+        self._m_join.labels(strategy=qq.join_strategy).inc()
+
+    # ------------------------------------------------------------------
+    # tensor-join strategy routing (wukong_tpu/join/)
+    # ------------------------------------------------------------------
+    def classify_join_strategy(self, q: SPARQLQuery) -> str:
+        """Plan-time walk/wcoj strategy for a PLANNED query, memoized per
+        template signature + store version through the plan cache (the
+        ``lane`` pattern). The mutable knobs join the memo key so a
+        runtime ``join_strategy``/``wcoj_ratio`` change applies
+        immediately instead of serving stale decisions."""
+        pg = q.pattern_group
+        if (pg.unions or pg.optional or q.planner_empty
+                or not pg.patterns):
+            return "walk"
+        knob = str(Global.join_strategy).strip().lower()
+        if knob == "walk":
+            return "walk"
+        if self.planner is None or not Global.enable_planner:
+            # no cost model: only the forced knob may route wcoj
+            if knob != "wcoj":
+                return "walk"
+            from wukong_tpu.join.qgraph import analyze
+
+            return "wcoj" if analyze(pg.patterns).supported else "walk"
+        sig = template_signature(q)
+        pats = list(pg.patterns)
+        key_extra = (knob, int(Global.wcoj_ratio),
+                     int(Global.wcoj_min_rows))
+        return self._plan_cache.aux(
+            "strategy", sig, (*self._plan_version(), *key_extra),
+            lambda: self.planner.choose_strategy(pats))
+
+    def wcoj(self):
+        """Lazily-built WCOJ executor over the host partition (its sorted
+        edge tables are cached per store version, so dynamic inserts and
+        stream commits self-invalidate like the plan cache)."""
+        if self._wcoj is None:  # unguarded: double-checked fast path — an atomic reference read; construction is serialized below
+            with self._batcher_init_lock:
+                if self._wcoj is None:
+                    from wukong_tpu.join.wcoj import WCOJExecutor
+
+                    self._wcoj = WCOJExecutor(
+                        self.g, self.str_server,
+                        stats=getattr(self.planner, "stats", None))
+        return self._wcoj  # unguarded: write-once reference, non-None past init
 
     # ------------------------------------------------------------------
     # heavy-lane routing (runtime/batcher.py heavy path)
@@ -402,7 +458,23 @@ class Proxy:
         single allowlisted direct-dispatch site for interactive queries.
         ``pinned`` (an explicit device= request) always bypasses: the
         batcher picks its own engine, which would silently override the
-        caller's pin."""
+        caller's pin. A query the planner routed ``wcoj`` executes on the
+        tensor-join engine first — any join-phase failure (unsupported
+        residue, injected ``join.materialize`` fault, a bug) degrades to
+        the walk below with the query untouched, never to an error."""
+        if getattr(q, "join_strategy", "walk") == "wcoj" and not pinned \
+                and eng is not self.dist:
+            try:
+                self.wcoj().try_execute(q)
+                return q
+            except Exception as e:
+                reason = (e.code.name if isinstance(e, WukongError)
+                          else type(e).__name__)
+                self._m_join_fallback.labels(reason=reason).inc()
+                tr = getattr(q, "trace", None)
+                if tr is not None:
+                    tr.event("join.fallback", reason=reason)
+                log_info(f"wcoj degraded to the walk ({reason})")
         if Global.enable_batching and not pinned and eng is not None \
                 and eng is not self.dist:
             pend = self.batcher().offer(q)

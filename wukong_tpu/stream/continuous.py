@@ -131,6 +131,7 @@ class StandingQuery:
     window: object = None  # EpochWindow | None
     wstore: object = None  # private window store (windowed queries only)
     base_triples: object = None  # static base included in window rebuilds
+    support: object = None  # SupportIndex (windowed queries only)
     callback: object = None  # push-mode sink: fn(ResultDelta), exceptions contained
     seen: set = field(default_factory=set)
     sink: list = field(default_factory=list)  # list[ResultDelta]
@@ -217,12 +218,17 @@ class ContinuousEngine:
             nvars=query.result.nvars, term_plans=term_plans,
             callback=callback)
         if window is not None:
-            from wukong_tpu.stream.windows import EpochWindow, WindowSpec
+            from wukong_tpu.stream.windows import (
+                EpochWindow,
+                SupportIndex,
+                WindowSpec,
+            )
 
             if not isinstance(window, WindowSpec):
                 raise WukongError(ErrorCode.UNSUPPORTED_SHAPE,
                                   "window must be a WindowSpec")
             sq.window = EpochWindow(spec=window)
+            sq.support = SupportIndex()
             if base_triples is not None:
                 sq.base_triples = np.asarray(base_triples, dtype=np.int64)
             sq.wstore = self._build_window_store(sq)
@@ -231,6 +237,11 @@ class ContinuousEngine:
         # the standing set — epochs only ever add deltas on top of it
         self._snapshot(sq, self.last_epoch,
                        sq.wstore if sq.window is not None else self.g)
+        if sq.support is not None:
+            # the registration window is empty, so everything seen so far
+            # derives from base_triples alone — permanent support (base
+            # triples never retire)
+            sq.support.note_base(sq.seen)
         self.queries[qid] = sq
         return qid
 
@@ -319,9 +330,16 @@ class ContinuousEngine:
                 degraded_epochs=d["degraded_epochs"],
                 callback_errors=d["callback_errors"])
             if d["window"] is not None:
+                from wukong_tpu.stream.windows import SupportIndex
+
                 sq.window = EpochWindow(spec=WindowSpec(*d["window"]),
                                         live=list(d["window_live"]))
                 sq.wstore = self._build_window_store(sq)
+                # support evidence is process-local and rebuilt empty: the
+                # retirement path never DEPENDS on it for correctness (the
+                # overdelete evaluation drives candidates), it only loses
+                # its fast paths until evidence re-accumulates
+                sq.support = SupportIndex()
             if d["had_callback"]:
                 log_warn(f"standing query {sq.qid}: push callback did not "
                          "survive the restart — re-register the sink")
@@ -476,6 +494,11 @@ class ContinuousEngine:
                          f"failed at epoch {epoch}: {e!r}")
         if degraded:
             sq.degraded_epochs += 1
+        if sq.support is not None and not degraded:
+            # per-result support: this epoch's evidence is EVERY row its
+            # delta derived (not just the fresh ones — an already-seen row
+            # re-derived here is kept alive by this epoch too)
+            sq.support.note_epoch(epoch, new_rows)
         fresh = new_rows - sq.seen
         if fresh:
             sq.seen |= fresh
@@ -544,11 +567,19 @@ class ContinuousEngine:
 
         retired = sq.window.add(epoch, triples)
         if retired:
-            # expiry is not incrementalizable without support counting:
-            # rebuild the window store from the survivors and refresh the
-            # full result set; the diff yields additions AND retractions
-            sq.wstore = self._build_window_store(sq)
-            self._snapshot(sq, epoch, sq.wstore)
+            try:
+                self._retire_incremental(sq, epoch, triples, retired)
+            except Exception as e:
+                # a failed retirement step must not strand half-updated
+                # bookkeeping — degrade to the old full refresh (rebuild +
+                # re-run + diff): correct, just not incremental
+                log_warn(f"standing query {sq.qid}: incremental "
+                         f"retirement at epoch {epoch} degraded to full "
+                         f"refresh: {e!r}")
+                sq.wstore = self._build_window_store(sq)
+                if sq.support is not None:
+                    sq.support.reset()
+                self._snapshot(sq, epoch, sq.wstore)
             return
         try:
             # the private window-store insert is a dynamic.insert fault
@@ -567,7 +598,98 @@ class ContinuousEngine:
             log_warn(f"standing query {sq.qid}: windowed epoch {epoch} "
                      f"degraded to full refresh: {e!r}")
             sq.wstore = self._build_window_store(sq)
+            if sq.support is not None:
+                sq.support.reset()
             self._snapshot(sq, epoch, sq.wstore)
+
+    def _retire_incremental(self, sq: StandingQuery, epoch: int,
+                            triples: np.ndarray, retired: list) -> None:
+        """Per-result support-counted retraction (windows.py module doc):
+        overdelete candidates from a delta evaluation seeded with the
+        RETIRED triples, base-support fast path, targeted re-derivation
+        over the rebuilt survivor store, then normal delta evaluation of
+        the arriving epoch. Retraction work scales with the rows touching
+        retired data, not with the standing result."""
+        from wukong_tpu.engine.cpu import CPUEngine
+
+        pre_store = sq.wstore  # base + previously-live epochs
+        retired_triples = np.concatenate([t for _, t in retired])
+        # 1. overdelete: every row with >=1 derivation using retired data
+        cand = self._eval_terms_inline(
+            sq, retired_triples, CPUEngine(pre_store, self.str_server))
+        cand &= sq.seen
+        # 2. support: evidence-exhausted rows are candidates by
+        # construction (safety net, normally a subset of the overdelete);
+        # base-supported rows never retract and skip verification
+        if sq.support is not None:
+            cand |= sq.support.retire([e for e, _ in retired]) & sq.seen
+            cand -= sq.support.base
+        # 3. survivor store INCLUDING the arriving epoch: a candidate row
+        # re-derivable through the new triples must not flicker -/+ in
+        # one epoch
+        sq.wstore = self._build_window_store(sq)
+        # 4. re-derive the candidates; the rest of the standing set keeps
+        # all its derivations and is untouched
+        dead = (cand - self._verify_rows(sq, cand)) if cand else set()
+        if dead:
+            sq.seen -= dead
+            self._push(sq, ResultDelta(
+                epoch=epoch, sign=-1,
+                rows=np.asarray(sorted(dead), dtype=np.int64)))
+        # 5. additions from the arriving epoch (already in the store)
+        self._delta_eval(sq, epoch, triples,
+                         CPUEngine(sq.wstore, self.str_server))
+
+    def _eval_terms_inline(self, sq: StandingQuery,
+                           triples: np.ndarray, engine) -> set:
+        """All projected rows derivable with >=1 triple from ``triples``
+        against ``engine``'s store (the semi-naive term union, inline).
+        Raises on any term failure — the caller falls back to a full
+        refresh rather than trusting an incomplete candidate set."""
+        rows: set = set()
+        for i, pat in enumerate(sq.patterns):
+            vars_, seed = match_delta(pat, triples)
+            if len(seed) == 0:
+                continue
+            q = self._make_delta_query(sq, i, vars_, seed)
+            out = engine.execute(q, from_proxy=False)
+            if out.result.status_code != ErrorCode.SUCCESS:
+                raise WukongError(out.result.status_code,
+                                  f"retirement term {i} failed")
+            rows |= self._project(out.result, sq.required_vars)
+        return rows
+
+    def _verify_rows(self, sq: StandingQuery, cand: set) -> set:
+        """Which candidate projected rows still have a full derivation
+        over the current window store: seed the BGP with the candidate
+        bindings (planned off the projection vars) and re-derive."""
+        from wukong_tpu.engine.cpu import CPUEngine
+        from wukong_tpu.planner.heuristic import plan_seeded_group
+
+        if not cand:
+            return set()
+        pg = PatternGroup(
+            patterns=[copy.copy(p) for p in sq.proto.pattern_group.patterns],
+            filters=sq.proto.pattern_group.filters)
+        if not plan_seeded_group(pg, set(sq.required_vars)):
+            # cannot anchor on the projection vars (registration rejects
+            # cartesian shapes, so this cannot happen) — caller refreshes
+            raise WukongError(ErrorCode.UNSUPPORTED_SHAPE,
+                              "verification not anchorable")
+        q = SPARQLQuery()
+        q.pattern_group = pg
+        res = q.result
+        res.nvars = sq.nvars
+        for col, v in enumerate(sq.required_vars):
+            res.add_var2col(v, col)
+        res.set_table(np.asarray(sorted(cand), dtype=np.int64))
+        res.blind = True
+        out = CPUEngine(sq.wstore, self.str_server).execute(
+            q, from_proxy=False)
+        if out.result.status_code != ErrorCode.SUCCESS:
+            raise WukongError(out.result.status_code,
+                              "candidate re-derivation failed")
+        return self._project(out.result, sq.required_vars)
 
     def _snapshot(self, sq: StandingQuery, epoch: int, store) -> None:
         """Full (non-incremental) evaluation against ``store``; the diff

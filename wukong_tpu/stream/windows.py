@@ -19,13 +19,28 @@ Semantics (one rule covers both classic shapes):
   mid-window epoch is never evaluated against an already-reported window.
 
 Retraction strategy: delta evaluation is monotone (append-only), so expiry
-cannot be incrementalized without per-result support counting. Instead the
-window keeps the raw triples of each live epoch; on retirement the standing
-query's window store is rebuilt from the surviving epochs and the query is
-re-run from scratch over it (continuous.py `_on_epoch_windowed`). Rebuilds
-happen once per ``slide`` epochs — the amortized shape Wukong+S gets from its
-per-window sub-stores — and the diff against the previous result set yields
-the retraction deltas.
+needs its own machinery. The window keeps the raw triples of each live
+epoch plus a per-result :class:`SupportIndex`; on retirement the standing
+query retracts *incrementally* (continuous.py ``_retire_incremental``):
+
+1. **Overdelete candidates** — delta evaluation seeded from the RETIRED
+   triples over the pre-retirement window store finds exactly the result
+   rows with at least one derivation touching retired data; every other
+   row keeps all its derivations and is untouched (the DRed overdelete
+   step, scoped to windows).
+2. **Support fast path** — rows whose support includes the static base
+   (derived at registration from ``base_triples`` alone, which never
+   retire) skip verification entirely; the per-epoch evidence counts
+   bound the candidate set from below (an evidence-exhausted row is
+   always a candidate).
+3. **Re-derive** — the surviving candidates are re-verified by seeding
+   the full BGP with their projected bindings over the rebuilt survivor
+   store; rows with no remaining derivation emit retraction deltas.
+
+Retraction work is therefore proportional to the rows actually touching
+retired epochs, not to the full standing result (the old behavior — a
+from-scratch re-run + diff per close — survives only as the fallback when
+a retirement step fails).
 """
 
 from __future__ import annotations
@@ -33,6 +48,62 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 
 import numpy as np
+
+
+@dataclass
+class SupportIndex:
+    """Per-result support bookkeeping for one windowed standing query.
+
+    ``base`` holds rows derivable from the static ``base_triples`` alone
+    (recorded at registration; base triples never retire, so these rows
+    never retract and skip re-verification). ``by_epoch`` records, per
+    live epoch, the rows that epoch's delta evaluation derived — its
+    memory is bounded by the window size. ``counts`` is the live evidence
+    count per row (how many live-epoch deltas derived it, the "support"
+    the retirement step consumes).
+    """
+
+    base: set = field(default_factory=set)
+    by_epoch: dict = field(default_factory=dict)  # epoch -> set(rows)
+    counts: dict = field(default_factory=dict)  # row -> live evidence
+
+    def note_base(self, rows) -> None:
+        self.base |= set(rows)
+
+    def note_epoch(self, epoch: int, rows) -> None:
+        rows = set(rows)
+        self.by_epoch[int(epoch)] = rows
+        for r in rows:
+            self.counts[r] = self.counts.get(r, 0) + 1
+
+    def retire(self, epochs) -> set:
+        """Drop retired epochs' evidence; returns the rows whose live
+        evidence is now exhausted (excluding base-supported rows) — a
+        LOWER bound on the retraction candidates: a row with surviving
+        evidence may still be dead (its surviving-epoch derivation can
+        use retired triples), which is why the overdelete evaluation, not
+        this set, drives candidate selection."""
+        dead = set()
+        for e in epochs:
+            for r in self.by_epoch.pop(int(e), ()):
+                c = self.counts.get(r, 0) - 1
+                if c <= 0:
+                    self.counts.pop(r, None)
+                    if r not in self.base:
+                        dead.add(r)
+                else:
+                    self.counts[r] = c
+        return {r for r in dead if self.counts.get(r, 0) == 0}
+
+    def support_of(self, row) -> int:
+        """Live evidence count (+1 if base-supported) — telemetry."""
+        return self.counts.get(row, 0) + (1 if row in self.base else 0)
+
+    def reset(self) -> None:
+        """Forget per-epoch evidence (full-refresh fallback); the base
+        set stays — base triples never retire, so it can't go stale."""
+        self.by_epoch.clear()
+        self.counts.clear()
 
 
 @dataclass(frozen=True)
