@@ -757,6 +757,75 @@ def serve_mixed_main(device_ok: bool) -> None:
     }, "BENCH_SERVE_MIXED.json")
 
 
+def tenants_main(device_ok: bool) -> None:
+    """`bench.py --tenants`: the multi-tenant SLO scenario
+    (Emulator.run_tenants — ROADMAP item 4's acceptance fixture) on the
+    LUBM-1 serving world: three conflicting tenant classes drive
+    closed-loop clients through proxy.serve_query with tenant identity;
+    per-tenant compliance / error budget / burn rates land in the SLO
+    tracker and the artifact. A chaos sub-run injects transient failures
+    at the proxy.serve boundary and records which tenants' budgets trip
+    the burn sentinel. Artifact: BENCH_TENANT.json (tenant_qps headline,
+    trended by scripts/bench_report.py)."""
+    import numpy as np
+
+    from wukong_tpu.config import Global
+    from wukong_tpu.engine.cpu import CPUEngine
+    from wukong_tpu.engine.tpu import TPUEngine
+    from wukong_tpu.loader.lubm import UB
+    from wukong_tpu.planner.optimizer import Planner
+    from wukong_tpu.runtime.emulator import Emulator
+    from wukong_tpu.runtime.proxy import Proxy
+    from wukong_tpu.types import OUT
+
+    scale = int(os.environ.get("WUKONG_BENCH_SCALE", "0")) or 1
+    g, ss, stats = _ensure_world(scale)
+    proxy = Proxy(g, ss, cpu_engine=CPUEngine(g, ss),
+                  tpu_engine=TPUEngine(g, ss, stats=stats),
+                  planner=Planner(stats))
+    pid = ss.str2id(f"<{UB}advisor>")
+    anchors = np.asarray(g.get_index(pid, OUT))
+    texts = [f"SELECT ?s WHERE {{ ?s <{UB}advisor> "
+             f"{ss.id2str(int(a))} . }}" for a in anchors[:512]]
+    dur = float(os.environ.get("WUKONG_TENANT_DURATION", "8"))
+    emu = Emulator(proxy)
+    for t in texts[:8]:  # warm parse/plan caches + engine jit shapes
+        proxy.serve_query(t, blind=True)
+
+    normal = emu.run_tenants(texts, duration_s=dur, warmup_s=1.0, seed=1)
+    chaos = emu.run_tenants(texts, duration_s=min(dur, 4.0), warmup_s=0.5,
+                            chaos=True, seed=1)
+
+    def slim(out: dict) -> dict:
+        # the committed detail keeps the per-tenant story and drops the
+        # full signal/registry dumps (scrape surfaces carry those live)
+        return {k: out[k] for k in ("duration_s", "chaos", "chaos_p",
+                                    "qps", "tenants", "alerts",
+                                    "burn_dumps")}
+
+    _emit_final({
+        "metric": f"LUBM-{scale} multi-tenant SLO scenario: 3 conflicting "
+                  "tenant classes (gold/silver/bulk), closed-loop serving "
+                  "with per-tenant SLO accounting + chaos burn variant",
+        "value": normal["qps"],
+        "unit": "q/s",
+        "tenant_qps": normal["qps"],
+        "chaos_alerts": chaos["alerts"],
+        "chaos_burn_dumps": len(chaos["burn_dumps"]),
+        "backend": "tpu" if device_ok else "cpu",
+        "detail": {
+            "normal": slim(normal),
+            "chaos": slim(chaos),
+            "slo_report": normal["slo_report"],
+            "knobs": {"max_tenants": Global.max_tenants,
+                      "slo_burn_fast_x": Global.slo_burn_fast_x,
+                      "slo_burn_slow_x": Global.slo_burn_slow_x,
+                      "slo_dump_cooldown_s": Global.slo_dump_cooldown_s},
+            "dataset": DATASET_NOTES["lubm"],
+        },
+    }, "BENCH_TENANT.json")
+
+
 def cyclic_main(device_ok: bool) -> None:
     """`bench.py --cyclic`: the cyclic workload suite (triangle / diamond /
     4-clique synthetic worlds + the WatDiv-based cyclic query set), each
@@ -829,6 +898,10 @@ def cyclic_main(device_ok: bool) -> None:
         "triangle_wcoj_ms": tri["wcoj_ms"],
         "rows_identical": all(d["rows_identical"] for d in detail.values()),
         "auto_strategies": {n: d["auto_strategy"] for n, d in detail.items()},
+        # settled-auto wall over the forced walk, per case (>= ~1.0 means
+        # the measured-blowup feedback keeps auto from losing to the walk)
+        "auto_vs_walk": {n: d["auto_vs_walk"] for n, d in detail.items()},
+        "auto_vs_walk_min": min(d["auto_vs_walk"] for d in detail.values()),
         "backend": "cpu",  # host executors on both sides (the XLA path
         # rides the same kernels; the strategy win is algorithmic)
         "detail": {**detail,
@@ -841,18 +914,34 @@ def cyclic_main(device_ok: bool) -> None:
 def _cyclic_case(name, g, stats, planner, spec, mkq, CPUEngine,
                  WCOJExecutor, reps: int) -> dict:
     """One cyclic-suite case: plan once, run walk-forced and wcoj-forced,
-    compare rows and best-of-reps wall time."""
+    compare rows and best-of-reps wall time. Additionally runs the AUTO
+    route through a real proxy so the measured-blowup feedback loop
+    (Proxy._record_wcoj_feedback) settles the strategy the way live
+    serving would — the artifact records both the first (estimate-driven)
+    and the settled (measurement-corrected) decision plus the settled
+    auto wall time."""
     from wukong_tpu.config import Global
+    from wukong_tpu.runtime.proxy import Proxy
 
     def planned():
         q = mkq(spec)
         planner.generate_plan(q)
         return q
 
-    auto = planner.choose_strategy(planned().pattern_group.patterns)
     cpu = CPUEngine(g)
     wc = WCOJExecutor(g, stats=stats)
     wc.tables.clear()
+
+    proxy = Proxy(g, None, cpu)
+    proxy.planner = planner
+
+    def auto_run():
+        q = planned()
+        q.join_strategy = proxy.classify_join_strategy(q)
+        t0 = time.perf_counter()
+        proxy._serve_execute(q, cpu)
+        assert q.result.status_code == 0, (name, q.result.status_code)
+        return (time.perf_counter() - t0) * 1e3, q.join_strategy
 
     def run(engine, blind=True):
         best, rows = None, None
@@ -874,13 +963,25 @@ def _cyclic_case(name, g, stats, planner, spec, mkq, CPUEngine,
 
     walk_ms, walk_rows, walk_set = run(cpu)
     wcoj_ms, wcoj_rows, wcoj_set = run(wc)
+    # the auto route with measured feedback: the first run may route wcoj
+    # on the over-predicted estimate, measure its prefix blowup, and
+    # demote; best-of-reps is taken AFTER the decision settles
+    first_ms, first_strategy = auto_run()
+    auto_ms, settled = None, first_strategy
+    for _ in range(reps):
+        dt, settled = auto_run()
+        auto_ms = dt if auto_ms is None else min(auto_ms, dt)
     return {
         "walk_ms": round(walk_ms, 1), "wcoj_ms": round(wcoj_ms, 1),
         "speedup": round(walk_ms / wcoj_ms, 2) if wcoj_ms else None,
         "rows": int(walk_rows),
         "rows_identical": bool(walk_rows == wcoj_rows
                                and walk_set == wcoj_set),
-        "auto_strategy": auto,
+        "auto_strategy": settled,
+        "auto_first_strategy": first_strategy,
+        "auto_first_ms": round(first_ms, 1),
+        "auto_ms": round(auto_ms, 1),
+        "auto_vs_walk": round(walk_ms / auto_ms, 2) if auto_ms else None,
         "est_peak_over_final": _est_ratio(planner, planned()),
     }
 
@@ -2048,6 +2149,9 @@ def main():
         return
     if "--cyclic" in sys.argv:
         cyclic_main(device_ok)
+        return
+    if "--tenants" in sys.argv:
+        tenants_main(device_ok)
         return
     if "--watdiv" in sys.argv:
         watdiv_main(device_ok)

@@ -57,8 +57,9 @@ def _headline(d: dict) -> dict | None:
         return {"value": float(d["value"]), "unit": d.get("unit", ""),
                 "metric": str(d.get("metric", ""))[:160]}
     # serving artifact: qps headline without a value field (mixed_qps:
-    # the --serve-mixed light+heavy closed loop, BENCH_SERVE_MIXED.json)
-    for key in ("batched_qps", "mixed_qps", "qps", "thpt_qps"):
+    # the --serve-mixed light+heavy closed loop, BENCH_SERVE_MIXED.json;
+    # tenant_qps: the --tenants multi-tenant SLO scenario, BENCH_TENANT.json)
+    for key in ("batched_qps", "mixed_qps", "tenant_qps", "qps", "thpt_qps"):
         if isinstance(d.get(key), (int, float)):
             return {"value": float(d[key]), "unit": "q/s", "metric": key}
     # cyclic suite: the triangle walk-vs-wcoj ratio (BENCH_CYCLIC.json;

@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# One-shot CI gate runner: static analysis + tier-1 tests + bench trend
+# check — the three checks a PR must pass, in the order that fails
+# fastest. Mirrors ROADMAP.md's tier-1 verify command (without the log
+# plumbing the driver adds) so local runs and CI agree on what "green"
+# means. Usage: scripts/ci_check.sh [extra pytest args...]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== wukong-analyze (static gates) =="
+python -m wukong_tpu.analysis  # exits non-zero on any gate violation
+
+echo "== tier-1 pytest (-m 'not slow') =="
+JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider "$@"
+
+echo "== bench trajectory check =="
+python scripts/bench_report.py --check
+
+echo "ci_check: all green"

@@ -237,8 +237,8 @@ def test_traced_query_has_proxy_and_step_spans(proxy, monkeypatch):
     assert steps[-1].attrs["rows_out"] == q.result.nrows
     # reply status reached the registry
     assert get_registry().counter(
-        "wukong_queries_total", labels=("status",)).value(
-            status="SUCCESS") >= 1
+        "wukong_queries_total", labels=("status", "tenant")).value(
+            status="SUCCESS", tenant="default") >= 1
 
 
 def test_traced_query_through_engine_pool_has_queue_span(world, monkeypatch):
@@ -472,8 +472,8 @@ def test_parse_failure_still_reaches_reply_observability(proxy, monkeypatch):
     [tr] = get_recorder().last(1)
     assert tr.status == "SYNTAX_ERROR"
     assert get_registry().counter(
-        "wukong_queries_total", labels=("status",)).value(
-            status="SYNTAX_ERROR") >= 1
+        "wukong_queries_total", labels=("status", "tenant")).value(
+            status="SYNTAX_ERROR", tenant="default") >= 1
 
 
 def test_tracing_off_leaves_query_untouched(proxy):

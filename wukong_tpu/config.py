@@ -170,6 +170,39 @@ class GlobalConfig:
     # rows shown per section in the /top report and the `top` console verb
     top_k: int = 8
 
+    # ---- tenant-aware SLO plane (obs/slo.py; all mutable) ----
+    # per-tenant accounting at the proxy reply point: tenant-labeled reply
+    # counters/latency histograms, per-tenant in-flight + arrival-rate
+    # EWMAs, and the overload signal bus item 4's admission controller
+    # consumes. Default ON: the per-reply cost is a few leaf-lock counter
+    # updates (the PR 3/PR 7 zero-measurable-overhead posture; guarded by
+    # BENCH_SERVE.json detail.tenant_accounting). Off degrades every hook
+    # to one knob check.
+    enable_tenant_accounting: bool = True
+    # bounded label cardinality: at most this many distinct tenant label
+    # values; later tenants land in the "__overflow__" bucket (a hostile
+    # or buggy client must not mint unbounded metric series)
+    max_tenants: int = 64
+    # config-declared SLO specs: ";"-separated
+    # "<tenant>:<percentile>:<latency_ms>:<availability>" entries, e.g.
+    # "gold:95:50:0.999;bulk:95:0:0.9" (latency_ms 0 = availability-only).
+    # Runtime registration: obs.slo.get_slo().register(SLOSpec(...)).
+    slo_specs: str = ""
+    # per-tenant reply samples kept for compliance / percentile math
+    slo_window: int = 512
+    # burn-rate windows (SRE-workbook multi-window): the fast window
+    # catches a sudden cliff, the slow window filters blips. Seconds;
+    # defaults are the canonical 5m / 1h pair
+    slo_fast_window_s: int = 300
+    slo_slow_window_s: int = 3600
+    # burn-rate thresholds (x the sustainable budget-consumption rate):
+    # the sentinel pages only when BOTH windows exceed their threshold
+    slo_burn_fast_x: int = 14
+    slo_burn_slow_x: int = 6
+    # per-tenant sentinel re-arm delay: one burn episode = one counted
+    # alert + one dumped trace per window, not a storm
+    slo_dump_cooldown_s: int = 60
+
     # ---- concurrency checking (wukong_tpu/analysis/lockdep.py) ----
     # lockdep-style runtime lock-order checker: locks created through the
     # analysis.lockdep factories become Debug wrappers that record the

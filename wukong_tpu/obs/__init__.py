@@ -10,6 +10,9 @@ and every perf PR after this one stands on:
 - recorder.py — :class:`FlightRecorder` ring of recent traces with
   auto-dump on resilience failures and slow queries
 - export.py   — Chrome trace-event JSON (Perfetto) + JAX device profiler
+- slo.py      — tenant-aware SLO plane: per-tenant accounting + error
+  budgets + burn-rate sentinels, and the overload signal bus
+  (``ADMISSION_INPUTS``) item 4's admission controller consumes
 
 Config knobs (all runtime-mutable, config.py): ``enable_tracing`` (default
 off — the hot path pays one getattr), ``trace_sample_every``,
@@ -32,6 +35,13 @@ from wukong_tpu.obs.httpd import (
 )
 from wukong_tpu.obs.metrics import MetricsRegistry, get_registry
 from wukong_tpu.obs.recorder import DUMP_CODES, FlightRecorder, get_recorder
+from wukong_tpu.obs.slo import (
+    ADMISSION_INPUTS,
+    SLOSpec,
+    get_overload,
+    get_slo,
+    render_slo,
+)
 from wukong_tpu.obs.trace import (
     QueryTrace,
     Span,
@@ -43,10 +53,11 @@ from wukong_tpu.obs.trace import (
 )
 
 __all__ = [
-    "DUMP_CODES", "FlightRecorder", "MetricsRegistry", "MetricsSnapshotter",
-    "QueryTrace", "Span", "StepTrace", "activate", "chrome_trace_events",
-    "current", "device_trace", "get_recorder", "get_registry",
+    "ADMISSION_INPUTS", "DUMP_CODES", "FlightRecorder", "MetricsRegistry",
+    "MetricsSnapshotter", "QueryTrace", "SLOSpec", "Span", "StepTrace",
+    "activate", "chrome_trace_events", "current", "device_trace",
+    "get_overload", "get_recorder", "get_registry", "get_slo",
     "maybe_device_trace", "maybe_start_metrics_http", "maybe_start_snapshotter",
-    "maybe_start_trace", "stop_metrics_http", "trace_event",
+    "maybe_start_trace", "render_slo", "stop_metrics_http", "trace_event",
     "write_chrome_trace",
 ]

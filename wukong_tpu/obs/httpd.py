@@ -5,10 +5,12 @@ scrape-*able* but nothing fronted it. This module adds:
 
 - :func:`maybe_start_metrics_http` — a stdlib ``http.server`` daemon thread
   serving ``GET /metrics`` (Prometheus text exposition), ``GET
-  /metrics.json`` (the JSON snapshot), and ``GET /top`` / ``/top.json``
+  /metrics.json`` (the JSON snapshot), ``GET /top`` / ``/top.json``
   (the shard/template/lane heat report, like ``top(1)`` — obs/profile.py
-  ``render_top``), gated on the ``metrics_port`` config knob (0 = off, the
-  default). Idempotent per process.
+  ``render_top``), and ``GET /slo`` / ``/slo.json`` (the per-tenant SLO +
+  overload-signal report — obs/slo.py ``render_slo``), gated on the
+  ``metrics_port`` config knob (0 = off, the default). Idempotent per
+  process.
 - :class:`MetricsSnapshotter` — a daemon thread that writes the registry's
   JSON snapshot to a file every ``interval_s`` seconds (atomic
   tmp-then-rename), for the emulator's long soaks where scraping is
@@ -42,11 +44,10 @@ class _MetricsHandler(BaseHTTPRequestHandler):
         elif path == "/metrics.json":
             body = json.dumps(get_registry().snapshot(), indent=1).encode()
             ctype = "application/json"
-        elif path in ("/top", "/top.json"):
-            # top(1) for shards / templates / lanes (obs/profile.py); ?k=N
+        elif path in ("/top", "/top.json", "/slo", "/slo.json"):
+            # top(1) for shards / templates / lanes (obs/profile.py), and
+            # the tenant SLO + overload-signal report (obs/slo.py); ?k=N
             # widens or narrows every section
-            from wukong_tpu.obs.profile import render_top
-
             k = None
             for part in query.split("&"):
                 if part.startswith("k="):
@@ -54,7 +55,14 @@ class _MetricsHandler(BaseHTTPRequestHandler):
                         k = max(int(part[2:]), 1)
                     except ValueError:
                         pass
-            text, js = render_top(k)
+            if path.startswith("/slo"):
+                from wukong_tpu.obs.slo import render_slo
+
+                text, js = render_slo(k)
+            else:
+                from wukong_tpu.obs.profile import render_top
+
+                text, js = render_top(k)
             if path.endswith(".json"):
                 body = json.dumps(js, indent=1).encode()
                 ctype = "application/json"
@@ -102,7 +110,7 @@ def maybe_start_metrics_http(port: int | None = None):
         t.start()
         _server = srv
         log_info(f"metrics http endpoint on :{srv.server_address[1]} "
-                 "(/metrics, /metrics.json, /top)")
+                 "(/metrics, /metrics.json, /top, /slo)")
         return srv
 
 

@@ -385,6 +385,8 @@ class LatencyAttributor:
                     st.last_trip_us = get_usec()
                     verdict = {
                         "template": template,
+                        # tenant-attributable without replaying the trace
+                        "tenant": getattr(trace, "tenant", "default"),
                         "total_us": total,
                         "baseline_p95_us": int(p95),
                         "component": worst,

@@ -86,7 +86,10 @@ class FlightRecorder:
         with self._lock:
             self.dumps.append((reason, trace))
         self._m_dumped.labels(reason=reason).inc()
-        log_warn(f"flight recorder: trace {trace.trace_id} dumped "
+        # the tenant rides the log line and the JSON (via to_dict) so an
+        # anomaly dump is attributable without replaying the trace
+        log_warn(f"flight recorder: trace {trace.trace_id} "
+                 f"(tenant {getattr(trace, 'tenant', 'default')}) dumped "
                  f"({reason}, {trace.dur_us:,}us, {len(trace.spans)} spans)")
         dump_dir = Global.trace_dump_dir or os.environ.get("WUKONG_TRACE_DIR")
         if dump_dir:

@@ -87,12 +87,16 @@ class QueryTrace:
     """
 
     def __init__(self, kind: str = "query", qid: int | None = None,
-                 text: str | None = None):
+                 text: str | None = None, tenant: str = "default"):
         n = next(_trace_seq)
         self.trace_id = f"{kind[0]}{n:06d}"
         self.kind = kind
         self.qid = n if qid is None else qid
         self.text = text
+        # tenant identity (obs/slo.py): the proxy stamps the bounded
+        # label at admission so every recorded/dumped trace is
+        # attributable to a tenant without replaying it
+        self.tenant = tenant
         self.t0_us = get_usec()
         self.t1_us: int | None = None
         self.status = "RUNNING"
@@ -166,6 +170,7 @@ class QueryTrace:
 
     def to_dict(self) -> dict:
         return {"trace_id": self.trace_id, "kind": self.kind, "qid": self.qid,
+                "tenant": self.tenant,
                 "status": self.status, "t0_us": self.t0_us,
                 "dur_us": self.dur_us,
                 **({"text": self.text} if self.text else {}),

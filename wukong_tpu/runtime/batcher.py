@@ -57,6 +57,7 @@ import numpy as np
 from wukong_tpu.analysis.lockdep import declare_leaf, make_condition, make_lock
 from wukong_tpu.config import Global
 from wukong_tpu.obs import activate, get_recorder, get_registry, maybe_start_trace
+from wukong_tpu.obs.slo import maybe_note_shed
 from wukong_tpu.runtime.resilience import CircuitBreaker, mark_partial
 from wukong_tpu.sparql.ir import Pattern, SPARQLQuery
 from wukong_tpu.types import NORMAL_ID_START, PREDICATE_ID, TYPE_ID, AttrType
@@ -243,6 +244,15 @@ class PlanCache:
         recipe = build_plan_recipe(parsed_patterns, q)
         if recipe is not None:
             self._lru.put((sig, version), recipe)
+
+    def put_aux(self, kind: str, sig, version, value) -> None:
+        """Overwrite one auxiliary plan fact (the WCOJ measured-blowup
+        feedback path: an execution-time measurement replaces the
+        estimate-derived memo under the SAME key, so the next
+        ``aux()`` lookup serves the corrected decision)."""
+        if sig is None:
+            return
+        self._lru.put((kind, sig, version), value)
 
     def aux(self, kind: str, sig, version, compute):
         """Memoized per-template auxiliary plan facts (device slice count,
@@ -503,6 +513,8 @@ class FusedGroup:
                 # shed in the batch queue: mirror the pool's load shedding
                 # (structured timeout, group unaffected)
                 _M_MEMBER_TIMEOUT.inc()
+                maybe_note_shed("batch_window",
+                                getattr(m.q, "tenant", "default"))
                 mark_partial(m.q, QueryTimeout("deadline expired in batch window"))
                 self._finish(m)
             else:
@@ -649,6 +661,8 @@ class FusedGroup:
                 self.batcher.cpu._final_process(m.q)
             except (QueryTimeout, BudgetExceeded) as e:
                 _M_MEMBER_TIMEOUT.inc()
+                maybe_note_shed("batch_settle",
+                                getattr(m.q, "tenant", "default"))
                 mark_partial(m.q, e)
             except Exception as e:
                 m.error = e
@@ -908,6 +922,8 @@ class HeavyGroup(FusedGroup):
                 self.batcher.cpu._final_process(m.q)
             except (QueryTimeout, BudgetExceeded) as e:
                 _M_MEMBER_TIMEOUT.inc()
+                maybe_note_shed("batch_settle",
+                                getattr(m.q, "tenant", "default"))
                 mark_partial(m.q, e)
             except Exception as e:
                 m.error = e

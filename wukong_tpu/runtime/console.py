@@ -22,7 +22,7 @@ quit                         quit from the console
 config <-v | -l <file> | -s <string>>   show/load/set config
 logger <level>               set log level (0..7)
 sparql -f <file> [-m <f>] [-n <n>] [-p <plan>] [-N] [-v <n>] [-d cpu|tpu|dist]
-                             run a single SPARQL query
+       [-t <tenant>]         run a single SPARQL query (as <tenant>)
 sparql -b <file>             run a batch of `sparql` commands from a file
 sparql-emu -f <mix_config> [-d <sec>] [-w <sec>] [-b <batch>] [-p <inflight>]
                              run the open-loop throughput emulator
@@ -43,6 +43,9 @@ analyze <-f <file> | -q <text>> [-d cpu|tpu|dist] [-j]
                              time / fetches + latency decomposition
 top [-k <n>] [-j]            hot shards / templates / lanes (like top(1);
                              also served at GET /top on the metrics port)
+slo [-k <n>] [-j]            per-tenant SLO compliance / error budgets /
+                             burn rates + the overload signal bus (also
+                             served at GET /slo on the metrics port)
 metrics [-j]                 dump the metrics registry (Prometheus text, -j JSON)
 checkpoint                   write one atomic checkpoint (partitions + stream
                              state) to checkpoint_dir; truncates covered WAL
@@ -100,6 +103,8 @@ class Console:
                 self._explain(rest, analyze=cmd == "analyze")
             elif cmd == "top":
                 self._top(rest)
+            elif cmd == "slo":
+                self._slo(rest)
             elif cmd == "metrics":
                 self._metrics(rest)
             elif cmd == "checkpoint":
@@ -137,6 +142,9 @@ class Console:
         ap.add_argument("-N", action="store_true", help="non-blind (ship results)")
         ap.add_argument("-v", type=int, default=0, help="print first N rows")
         ap.add_argument("-d", default=None, choices=["cpu", "tpu", "dist"])
+        ap.add_argument("-t", default="default",
+                        help="tenant identity stamped on the query "
+                             "(obs/slo.py accounting)")
         ns = ap.parse_args(rest)
         if (ns.f is None) == (ns.b is None):
             log_error("single mode (-f) and batch mode (-b) are exclusive "
@@ -168,7 +176,7 @@ class Console:
         blind = None if not (ns.N or ns.v) else False
         self.proxy.run_single_query(text, repeats=ns.n, plan_text=plan,
                                     mt_factor=ns.m, device=ns.d, blind=blind,
-                                    print_results=ns.v)
+                                    print_results=ns.v, tenant=ns.t)
 
     def _emu(self, rest) -> None:
         from wukong_tpu.obs import maybe_device_trace
@@ -286,6 +294,24 @@ class Console:
         ap.add_argument("-j", action="store_true", help="JSON output")
         ns = ap.parse_args(rest)
         text, js = render_top(ns.k)
+        if ns.j:
+            import json
+
+            print(json.dumps(js, indent=1, sort_keys=True, default=str))
+        else:
+            print(text, end="")
+
+    def _slo(self, rest) -> None:
+        """slo: per-tenant compliance / error budgets / burn rates + the
+        overload signal bus (the /slo endpoint's body)."""
+        from wukong_tpu.obs.slo import render_slo
+
+        ap = argparse.ArgumentParser(prog="slo")
+        ap.add_argument("-k", type=int, default=None,
+                        help="tenant rows shown (default: the top_k knob)")
+        ap.add_argument("-j", action="store_true", help="JSON output")
+        ns = ap.parse_args(rest)
+        text, js = render_slo(ns.k)
         if ns.j:
             import json
 

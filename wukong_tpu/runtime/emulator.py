@@ -586,6 +586,168 @@ class Emulator:
                 "separation": separation, "report": report}
 
     # ------------------------------------------------------------------
+    # multi-tenant SLO scenario (ROADMAP item 4 acceptance fixture)
+    # ------------------------------------------------------------------
+    def run_tenants(self, texts: list, duration_s: float = 3.0,
+                    warmup_s: float = 0.3, tenants: list | None = None,
+                    chaos: bool = False, chaos_p: float = 0.25,
+                    seed: int = 0) -> dict:
+        """N tenant classes with conflicting SLOs drive closed-loop
+        clients through the REAL serving entry (``serve_query`` with a
+        tenant identity), so per-tenant compliance, remaining error
+        budget, and multi-window burn rates land in the SLO tracker,
+        ``/slo.json``, and the rolling report — item 4's acceptance
+        fixture, the way ``run_hotspot`` is item 3's.
+
+        The default cast is three conflicting classes: ``gold`` (tight
+        latency target, three nines — almost no error budget), ``silver``
+        (moderate), and ``bulk`` (twice the clients, one nine — it floods
+        the engines the others contend with). ``chaos=True`` injects
+        transient failures at the ``proxy.serve`` boundary with the SAME
+        probability for every tenant: only tenants whose availability
+        budget cannot absorb the fault rate trip the burn sentinel, and
+        each trip dumps exactly one attributable trace per cooldown
+        window (tracing is forced on for the run so dumps have traces).
+        A tenant entry may carry its own ``texts`` list; otherwise all
+        classes share ``texts``.
+        """
+        import threading
+
+        from wukong_tpu.obs.slo import (
+            SLOSpec,
+            get_overload,
+            get_slo,
+            render_slo,
+            reset_labels,
+        )
+        from wukong_tpu.runtime import faults
+        from wukong_tpu.runtime.faults import FaultPlan, FaultSpec
+        from wukong_tpu.utils.logger import log_warn
+
+        classes = tenants if tenants is not None else [
+            {"tenant": "gold", "clients": 2,
+             "slo": SLOSpec("gold", 0.95, 50.0, 0.999)},
+            {"tenant": "silver", "clients": 2,
+             "slo": SLOSpec("silver", 0.95, 500.0, 0.99)},
+            {"tenant": "bulk", "clients": 4,
+             "slo": SLOSpec("bulk", 0.95, 0.0, 0.9)},
+        ]
+        tracker, signals = get_slo(), get_overload()
+        tracker.reset()  # the scenario's report starts from a clean slate
+        signals.reset()
+        reset_labels()
+        get_recorder().clear()
+        for c in classes:
+            if c.get("slo") is not None:
+                tracker.register(c["slo"])
+
+        prev_plan = faults.active()
+        prev_tracing = (Global.enable_tracing, Global.trace_sample_every)
+        if chaos:
+            # the burn dump must carry an attributable trace
+            Global.enable_tracing = True
+            Global.trace_sample_every = 1
+            faults.install(FaultPlan(
+                [FaultSpec("proxy.serve", "transient", p=chaos_p)],
+                seed=seed))
+
+        stop = threading.Event()
+        t_measure = [time.monotonic() + warmup_s]
+        stats = [{"served": 0, "errors": 0, "lat": []} for _ in classes]
+
+        def client(ti: int, k: int) -> None:
+            c = classes[ti]
+            pool = c.get("texts") or texts
+            name = c["tenant"]
+            rng = np.random.default_rng(seed * 1009 + ti * 31 + k)
+            while not stop.is_set():
+                text = pool[int(rng.integers(0, len(pool)))]
+                t0 = get_usec()
+                try:
+                    q = self.proxy.serve_query(text, blind=True,
+                                               tenant=name)
+                    ok = q.result.status_code == ErrorCode.SUCCESS
+                except Exception:
+                    ok = False
+                dt = get_usec() - t0
+                if time.monotonic() >= t_measure[0]:
+                    st = stats[ti]
+                    if ok:
+                        st["served"] += 1
+                        st["lat"].append(dt)
+                    else:
+                        st["errors"] += 1
+                    self.monitor.add_latency(dt, qtype=ti)
+
+        threads = [threading.Thread(target=client, args=(ti, k),
+                                    daemon=True,
+                                    name=f"tenant-{c['tenant']}-{k}")
+                   for ti, c in enumerate(classes)
+                   for k in range(int(c.get("clients", 1)))]
+        try:
+            for t in threads:
+                t.start()
+            t_end = time.monotonic() + warmup_s + duration_s
+            started = False
+            while time.monotonic() < t_end:
+                if not started and time.monotonic() >= t_measure[0]:
+                    self.monitor.start_thpt()
+                    started = True
+                self.monitor.maybe_print_thpt()
+                time.sleep(0.05)
+            stop.set()
+            for t in threads:
+                t.join(timeout=10)
+        finally:
+            stop.set()
+            faults.install(prev_plan)
+            Global.enable_tracing, Global.trace_sample_every = prev_tracing
+
+        out_tenants: dict = {}
+        total = 0
+        for ti, c in enumerate(classes):
+            name = c["tenant"]
+            st = stats[ti]
+            lat = sorted(st["lat"])
+            total += st["served"]
+            out_tenants[name] = {
+                "clients": int(c.get("clients", 1)),
+                "served": st["served"],
+                "errors": st["errors"],
+                "qps": round(st["served"] / duration_s, 1),
+                "p50_us": int(lat[len(lat) // 2]) if lat else 0,
+                "p99_us": int(lat[int(len(lat) * 0.99)]) if lat else 0,
+                "slo": tracker.compliance(name),
+            }
+        burn_dumps = [(r, tr) for (r, tr) in list(get_recorder().dumps)
+                      if r == "SLO_BURN"]
+        out = {
+            "duration_s": duration_s,
+            "chaos": bool(chaos),
+            "chaos_p": chaos_p if chaos else 0.0,
+            "qps": round(total / duration_s, 1),
+            "tenant_qps": round(total / duration_s, 1),
+            "tenants": out_tenants,
+            "alerts": {n: (d["slo"] or {}).get("alerts", 0)
+                       for n, d in out_tenants.items()},
+            "burn_dumps": [{"tenant": tr.tenant, "trace": tr.trace_id}
+                           for (_r, tr) in burn_dumps],
+            "slo_report": tracker.report(),
+            "signals": signals.report(),
+        }
+        for line in self.monitor.slo_lines(k=len(classes)):
+            log_info(line)
+        log_info(f"run_tenants: {out['qps']:,.0f} q/s over {duration_s}s"
+                 f" ({len(classes)} classes, chaos={chaos}); alerts "
+                 + " ".join(f"{n}:{a}" for n, a in out["alerts"].items()))
+        if chaos and not burn_dumps:
+            log_warn("run_tenants: chaos ran but no burn dump landed "
+                     "(thresholds/budgets absorb the fault rate?)")
+        _text, js = render_slo()
+        out["slo_json"] = js
+        return out
+
+    # ------------------------------------------------------------------
     # kill-and-recover drill (fault-tolerance fire drill)
     # ------------------------------------------------------------------
     def run_drill(self, shard: int = 1, texts: list | None = None,

@@ -31,6 +31,10 @@ Sites instrumented today:
 - ``join.materialize``  — WCOJ sorted-edge-table materialization in
   join/wcoj.py (fires before any result state is touched, so the proxy
   degrades the query to the walk instead of erroring)
+- ``proxy.serve``       — serving-boundary dispatch in runtime/proxy.py
+  (fires before any engine dispatch: an injected failure surfaces as a
+  client-visible error reply — the SLO-plane chaos scenario's way of
+  burning per-tenant error budgets through the real serving path)
 
 When no plan is installed every hook is a cheap no-op.
 """
@@ -62,6 +66,8 @@ KNOWN_FAULT_SITES = frozenset({
     "checkpoint.write",    # checkpoint bundle write (runtime/recovery.py)
     "batch.heavy.dispatch",  # fused heavy-lane dispatch (runtime/batcher.py)
     "join.materialize",    # WCOJ sorted-table materialization (join/wcoj.py)
+    "proxy.serve",         # serving-boundary dispatch (runtime/proxy.py;
+                           # the SLO-plane chaos scenario's injection point)
 })
 
 

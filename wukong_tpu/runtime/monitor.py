@@ -162,6 +162,8 @@ class Monitor:
                 log_info(line)
             for line in self.lane_lines():
                 log_info(line)
+            for line in self.slo_lines(k=3):
+                log_info(line)
             self._last_print = now
             self._last_cnt = self.cnt
 
@@ -274,6 +276,29 @@ class Monitor:
             snap, "wukong_batch_heavy_occupancy") or 0.0
         return [f"HeavyLane: depth {depth}, {disp} fused dispatches "
                 f"({heavy_sub} lane submits), mean group {mean:.1f}"]
+
+    def slo_lines(self, k: int = 3) -> list[str]:
+        """Rolling-report lines for the tenant SLO plane (obs/slo.py):
+        the k worst-burning spec'd tenants' compliance / remaining error
+        budget / burn rates — quiet when no tenant replies were observed
+        (single-tenant runs stay clean)."""
+        from wukong_tpu.obs.slo import get_slo
+
+        rows = [r for r in get_slo().report()["tenants"]
+                if r["spec"] is not None]
+        if not rows:
+            return []
+        parts = []
+        for r in rows[:k]:
+            burn = r.get("burn") or {}
+            parts.append(
+                f"{r['tenant']}: compl "
+                + ("-" if r["compliance"] is None
+                   else f"{r['compliance']:.1%}")
+                + f" budget {r.get('error_budget_remaining', 0):.0%}"
+                + f" burn {burn.get('fast', 0):.1f}/{burn.get('slow', 0):.1f}"
+                + (f" alerts {r['alerts']}" if r["alerts"] else ""))
+        return ["SLO[" + "  ".join(parts) + "]"]
 
     def heat_lines(self, k: int = 3) -> list[str]:
         """Rolling-report lines: the top-k hot shards, only when any fetch

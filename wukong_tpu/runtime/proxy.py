@@ -27,6 +27,7 @@ from wukong_tpu.obs import (
     maybe_start_metrics_http,
     maybe_start_trace,
 )
+from wukong_tpu.obs.slo import get_overload, get_slo, tenant_label
 from wukong_tpu.planner.heuristic import heuristic_plan
 from wukong_tpu.planner.plan_file import set_plan
 from wukong_tpu.runtime.batcher import (
@@ -77,8 +78,8 @@ class Proxy:
         self.recorder = get_recorder()
         self.metrics = get_registry()
         self._m_queries = self.metrics.counter(
-            "wukong_queries_total", "Proxy queries by reply status",
-            labels=("status",))
+            "wukong_queries_total", "Proxy queries by reply status and tenant",
+            labels=("status", "tenant"))
         self._m_lane = self.metrics.counter(
             "wukong_lane_routed_total",
             "Plan-time light/heavy lane routing decisions", labels=("lane",))
@@ -90,6 +91,9 @@ class Proxy:
         self._m_join_fallback = self.metrics.counter(
             "wukong_join_fallback_total",
             "WCOJ executions degraded to the walk", labels=("reason",))
+        self._m_join_demoted = self.metrics.counter(
+            "wukong_join_demotions_total",
+            "Templates demoted wcoj->walk by measured-blowup feedback")
         self._wcoj = None  # guarded by: _batcher_init_lock
         self._pool = None
         self._stream = None
@@ -192,8 +196,9 @@ class Proxy:
     def run_single_query(self, text: str, repeats: int = 1,
                          plan_text: str | None = None, mt_factor: int = 1,
                          device: str | None = None, blind: bool | None = None,
-                         print_results: int = 0) -> SPARQLQuery:
-        """sparql -f <file> [-n repeats] [-p plan] [-m mt] [-N] [-v N] (console.hpp:141-153)."""
+                         print_results: int = 0,
+                         tenant: str = "default") -> SPARQLQuery:
+        """sparql -f <file> [-n repeats] [-p plan] [-m mt] [-N] [-v N] [-t tenant] (console.hpp:141-153)."""
         if mt_factor > 1:
             # the reference fans an index scan out to mt_factor threads and
             # merges replies (sparql.hpp:1064-1088). The single-driver engines
@@ -203,25 +208,33 @@ class Proxy:
             log_info("-m (mt_factor) is vectorized away on this engine; "
                      "running the full index scan")
 
+        if repeats < 1:
+            # validate BEFORE admission: a raise past _admit would leak
+            # the tenant's in-flight slot (note_done never runs)
+            raise WukongError(ErrorCode.SYNTAX_ERROR, "repeats must be >= 1")
         # per-query trace context, created at receipt (sampled; None when
         # tracing is off — every downstream hook then degrades to a getattr)
         trace = maybe_start_trace(kind="query", text=text)
+        t0_us = get_usec()
+        # tenant admission: bounded label + overload-bus in-flight/arrival
+        # note (obs/slo.py; one knob check when accounting is off)
+        ten = self._admit(tenant)
+        if trace is not None:
+            trace.tenant = ten
 
         def prepare():
             if trace is None:
                 qq = self._parse_text(text)
-                self._plan_prepared(qq, blind, plan_text)
+                self._plan_prepared(qq, blind, plan_text, tenant=ten)
                 return qq
             with trace.span("proxy.parse"):
                 qq = self._parse_text(text)
             qq.trace = trace
             qq.qid = trace.qid
             with trace.span("proxy.plan"):
-                self._plan_prepared(qq, blind, plan_text)
+                self._plan_prepared(qq, blind, plan_text, tenant=ten)
             return qq
 
-        if repeats < 1:
-            raise WukongError(ErrorCode.SYNTAX_ERROR, "repeats must be >= 1")
         q = None
         total_us = 0
         # activate the trace on the proxy thread too (parse/plan/fallback
@@ -238,20 +251,27 @@ class Proxy:
             code = e.code if isinstance(e, WukongError) else "ERROR"
             self._m_queries.labels(
                 status=code.name if isinstance(code, ErrorCode)
-                else str(code)).inc()
+                else str(code), tenant=ten).inc()
             if trace is not None:
                 self.recorder.on_complete(trace, code)
+            self._observe_slo(ten, get_usec() - t0_us, ok=False,
+                              status=code, trace=trace)
             raise
         # reply-side observability: the finished trace enters the flight
         # recorder (auto-dumping on timeout/budget/shard failures), and the
         # reply status lands on the metrics registry
         status = q.result.status_code
-        self._m_queries.labels(status=status.name).inc()
+        self._m_queries.labels(status=status.name, tenant=ten).inc()
         if trace is not None:
             self.recorder.on_complete(trace, status)
             self._attribute(trace, q, text)
             log_info(f"trace {trace.trace_id} (qid {trace.qid}) recorded: "
                      f"{len(trace.spans)} spans, {trace.dur_us:,}us")
+        # SLO accounting after the trace is finished/recorded: a burn
+        # dump must serialize a completed trace, not a RUNNING one
+        self._observe_slo(ten, get_usec() - t0_us,
+                          ok=status == ErrorCode.SUCCESS, status=status,
+                          trace=trace)
         if q.result.status_code != ErrorCode.SUCCESS:
             if not q.result.complete:
                 # structured partial reply, not a crash: the rows produced
@@ -320,9 +340,37 @@ class Proxy:
                 break  # deadline/budget spent: repeats are pointless
         return q, total_us
 
-    def _plan_prepared(self, qq: SPARQLQuery, blind, plan_text) -> None:
-        """Shared prepare tail: blind mode, resilience knobs, planning,
-        plan-time lane routing."""
+    def _admit(self, tenant) -> str:
+        """Tenant admission: the bounded metric-label form of the tenant
+        id, plus the overload bus's in-flight/arrival note. With
+        accounting off this is one knob check and the raw id."""
+        if not Global.enable_tenant_accounting:
+            return str(tenant) if tenant else "default"
+        ten = tenant_label(tenant)
+        get_overload().note_admit(ten)
+        return ten
+
+    def _observe_slo(self, tenant: str, dur_us: int, ok: bool, status,
+                     trace) -> None:
+        """Reply-side SLO accounting (the LatencyAttributor observation
+        point): release the in-flight slot, count reply-side sheds, and
+        fold the reply into the tenant's SLO window — the burn-rate
+        sentinel fires from here. One knob check when accounting is off."""
+        if not Global.enable_tenant_accounting:
+            return
+        sig = get_overload()
+        sig.note_done(tenant)
+        if status == ErrorCode.QUERY_TIMEOUT:
+            sig.note_shed("reply_timeout", tenant)
+        elif status == ErrorCode.BUDGET_EXCEEDED:
+            sig.note_shed("reply_budget", tenant)
+        get_slo().observe(tenant, int(dur_us), ok, trace=trace)
+
+    def _plan_prepared(self, qq: SPARQLQuery, blind, plan_text,
+                       tenant: str = "default") -> None:
+        """Shared prepare tail: tenant stamp, blind mode, resilience
+        knobs, planning, plan-time lane routing."""
+        qq.tenant = tenant
         qq.mt_factor = 1
         qq.result.blind = Global.silent if blind is None else blind
         # per-query deadline + work budget from the resilience knobs
@@ -364,6 +412,55 @@ class Proxy:
         return self._plan_cache.aux(
             "strategy", sig, (*self._plan_version(), *key_extra),
             lambda: self.planner.choose_strategy(pats))
+
+    def _record_wcoj_feedback(self, q: SPARQLQuery) -> None:
+        """WCOJ auto-routing feedback (PR 9 headroom): after a successful
+        wcoj execution, record the MEASURED materialized-prefix blowup
+        (peak per-level ``rows_out`` over the final fragment) from
+        ``q.join_stats`` into the plan cache, and demote the template's
+        memoized ``auto`` strategy to the walk when wcoj did NOT deliver
+        its premise — intermediates bounded near the fragment. ``auto``
+        routes wcoj on the ESTIMATED walk blowup, which over-predicts on
+        the small WatDiv cyclic shapes (BENCH_CYCLIC.json
+        ``auto_strategies`` lose 2-3x to the walk there): when the join's
+        own materialized rows still blow past ``wcoj_ratio`` x final, it
+        is doing walk-like materialization PLUS per-level intersection
+        overhead, and the walk's simpler kernels win. Measured on the
+        cyclic suite: winners keep the prefix at ~1.0x final (triangle
+        1.0 / diamond 1.0) while the losers materialize 18-55x (clique4
+        18.5 / w_tri_likes 27 / w_tri_follows 55). The closing-level
+        CANDIDATE count is deliberately excluded — bounding candidates
+        while materializing few rows is exactly the leapfrog win, and a
+        candidate-based rule would demote the triangle's 14.8x speedup
+        (candidates/final = 2.9 there). The memo key mirrors
+        ``classify_join_strategy``'s exactly, so the demotion takes
+        effect on the very next same-template query, and a knob flip or
+        store mutation re-arms the estimate-driven decision."""
+        stats = getattr(q, "join_stats", None)
+        if (not stats or q.result.status_code != ErrorCode.SUCCESS
+                or str(Global.join_strategy).strip().lower() != "auto"
+                or self.planner is None or not Global.enable_planner):
+            return
+        sig = template_signature(q)
+        if sig is None:
+            return
+        final = max(int(stats[-1]["rows_out"]), 1)
+        peak = max(int(lv["rows_out"]) for lv in stats)
+        measured = peak / final
+        key = (*self._plan_version(), "auto", int(Global.wcoj_ratio),
+               int(Global.wcoj_min_rows))
+        self._plan_cache.put_aux("wcoj_measured", sig, key,
+                                 round(measured, 2))
+        # STRICTLY above the ratio: a prefix that stays at ~final rows
+        # measures exactly 1.0, and a forced wcoj_ratio of 1 must not
+        # demote the shapes wcoj is winning on
+        if measured > max(float(Global.wcoj_ratio), 1.0):
+            self._plan_cache.put_aux("strategy", sig, key, "walk")
+            self._m_join_demoted.inc()
+            log_info(f"wcoj auto-routing: template demoted to the walk "
+                     f"(measured prefix blowup {measured:.1f}x > "
+                     f"wcoj_ratio {Global.wcoj_ratio} — wcoj did not keep "
+                     "intermediates near the fragment)")
 
     def wcoj(self):
         """Lazily-built WCOJ executor over the host partition (its sorted
@@ -462,10 +559,17 @@ class Proxy:
         tensor-join engine first — any join-phase failure (unsupported
         residue, injected ``join.materialize`` fault, a bug) degrades to
         the walk below with the query untouched, never to an error."""
+        from wukong_tpu.runtime import faults
+
+        # the serving-boundary fault site: SLO-plane chaos scenarios
+        # (Emulator.run_tenants) inject client-visible failures here so
+        # per-tenant error budgets burn through the real reply path
+        faults.site("proxy.serve")
         if getattr(q, "join_strategy", "walk") == "wcoj" and not pinned \
                 and eng is not self.dist:
             try:
                 self.wcoj().try_execute(q)
+                self._record_wcoj_feedback(q)
                 return q
             except Exception as e:
                 reason = (e.code.name if isinstance(e, WukongError)
@@ -494,19 +598,27 @@ class Proxy:
         return q
 
     def serve_query(self, text: str, blind: bool | None = None,
-                    device: str | None = None) -> SPARQLQuery:
+                    device: str | None = None,
+                    tenant: str = "default") -> SPARQLQuery:
         """The lean serving entry (no repeats, no result printing): parse
         (cached) -> plan (cached) -> batched or direct execution, with the
         same shape/capacity fallbacks as run_single_query. This is the
-        path live traffic takes; run_single_query is the console surface."""
+        path live traffic takes; run_single_query is the console surface.
+        ``tenant`` is the caller's identity — stamped on the query, the
+        trace, and every reply-side metric (bounded to ``max_tenants``
+        label values), and fed to the SLO tracker at reply."""
         trace = maybe_start_trace(kind="query", text=text)
+        t0_us = get_usec()
+        ten = self._admit(tenant)
+        if trace is not None:
+            trace.tenant = ten
 
         def prepare():
             qq = self._parse_text(text)
             if trace is not None:
                 qq.trace = trace
                 qq.qid = trace.qid
-            self._plan_prepared(qq, blind, None)
+            self._plan_prepared(qq, blind, None, tenant=ten)
             return qq
 
         try:
@@ -516,14 +628,22 @@ class Proxy:
             code = e.code if isinstance(e, WukongError) else "ERROR"
             self._m_queries.labels(
                 status=code.name if isinstance(code, ErrorCode)
-                else str(code)).inc()
+                else str(code), tenant=ten).inc()
             if trace is not None:
                 self.recorder.on_complete(trace, code)
+            self._observe_slo(ten, get_usec() - t0_us, ok=False,
+                              status=code, trace=trace)
             raise
-        self._m_queries.labels(status=q.result.status_code.name).inc()
+        status = q.result.status_code
+        self._m_queries.labels(status=status.name, tenant=ten).inc()
         if trace is not None:
-            self.recorder.on_complete(trace, q.result.status_code)
+            self.recorder.on_complete(trace, status)
             self._attribute(trace, q, text)
+        # SLO accounting after the trace is finished/recorded (burn
+        # dumps serialize a completed trace)
+        self._observe_slo(ten, get_usec() - t0_us,
+                          ok=status == ErrorCode.SUCCESS, status=status,
+                          trace=trace)
         return q
 
     # ------------------------------------------------------------------

@@ -31,6 +31,7 @@ import time
 from wukong_tpu.analysis.lockdep import make_lock
 from wukong_tpu.config import Global
 from wukong_tpu.obs.metrics import get_registry
+from wukong_tpu.obs.slo import maybe_note_queue_delay, maybe_note_shed
 from wukong_tpu.utils.timer import get_usec
 
 # pool-level observability: submissions/sheds/respawns push counters; queue
@@ -80,6 +81,24 @@ def _lane_depth_series() -> dict:
 get_registry().gauge(
     "wukong_pool_lane_depth", "Queries waiting per pool lane",
     labels=("lane",)).set_function(_lane_depth_series)
+
+
+def _pool_utilization() -> float:
+    """Busy fraction of live engines across every live pool — an
+    ADMISSION_INPUTS signal (obs/slo.py) for item 4's admission control."""
+    busy = alive = 0
+    for p in list(_POOLS):
+        for t in range(p.n):
+            if not p._dead[t]:  # unguarded: report-only snapshot, like health()
+                alive += 1
+                if p._busy_since[t]:
+                    busy += 1
+    return busy / alive if alive else 0.0
+
+
+get_registry().gauge(
+    "wukong_pool_utilization",
+    "Busy fraction of live pool engines").set_function(_pool_utilization)
 
 
 class EnginePool:
@@ -217,6 +236,28 @@ class EnginePool:
         ev.set()
 
     @staticmethod
+    def _stamp_enqueue(query, lane: str) -> None:
+        """Queue-delay accounting for the overload signal bus (obs/slo.py):
+        submit stamps the enqueue clock, the popping engine charges the
+        per-lane delay EWMA. One knob check when accounting is off;
+        ``__slots__`` items (split slices) skip silently."""
+        if not Global.enable_tenant_accounting:
+            return
+        try:
+            query._slo_enq_us = get_usec()
+            query._slo_lane = lane
+        except AttributeError:
+            pass
+
+    @staticmethod
+    def _charge_queue_delay(query) -> None:
+        enq = getattr(query, "_slo_enq_us", None)
+        if enq is not None:
+            query._slo_enq_us = None
+            maybe_note_queue_delay(getattr(query, "_slo_lane", "default"),
+                                   get_usec() - enq)
+
+    @staticmethod
     def _end_queue_span(query, **attrs) -> None:
         """Close a traced query's pool.queue span. Every exit from the
         queue — popped by an engine, shed, or failed without ever being
@@ -344,6 +385,7 @@ class EnginePool:
                 queue = {"batch": self.batch_queue,  # unguarded: reference binding only, as above
                          "heavy": self.heavy_queue,  # unguarded: reference binding only, as above
                          "rebuild": self.rebuild_queue}[lane]  # unguarded: reference binding only, as above
+            self._stamp_enqueue(query, lane)
             with self._route_lock:
                 if all(self._dead[k] for k in range(self.n)):
                     fail = getattr(query, "fail_all", None)
@@ -365,6 +407,7 @@ class EnginePool:
         if tr is not None:
             query._obs_queue_span = tr.start_span(
                 "pool.queue", qid=qid, lane=lane or "default")
+        self._stamp_enqueue(query, lane or "default")
         if lane == "stream":
             with self._results_lock:
                 self._stream_qids.add(qid)
@@ -526,6 +569,7 @@ class EnginePool:
             qid, query = item
             self._inflight[tid] = item
             self._busy_since[tid] = get_usec()
+            self._charge_queue_delay(query)  # overload bus: per-lane EWMA
             if qid is None:  # batch/heavy lanes: fire-and-forget items
                 try:
                     from wukong_tpu.runtime import faults
@@ -556,6 +600,8 @@ class EnginePool:
                     from wukong_tpu.utils.errors import QueryTimeout
 
                     _M_SHED.inc()
+                    maybe_note_shed("queue_deadline",
+                                    getattr(query, "tenant", "default"))
                     raise QueryTimeout(
                         f"deadline expired in engine-{tid} queue")
                 from wukong_tpu.runtime import faults

@@ -187,6 +187,12 @@ class SPARQLQuery:
     # None = unconstrained. Engines check it at each BGP step; the proxy
     # attaches one from the Global knobs and children inherit the parent's.
     deadline: object = None
+    # tenant identity (obs/slo.py): stamped by the proxy at admission
+    # (bounded to max_tenants label values) and carried proxy -> batcher
+    # -> scheduler -> engines so every metric, trace, queue decision, and
+    # shed counter downstream is tenant-attributable. "default" keeps the
+    # single-tenant path byte-identical.
+    tenant: str = "default"
 
     def get_pattern(self, step: int | None = None) -> Pattern:
         s = self.pattern_step if step is None else step
