@@ -53,6 +53,15 @@ def _headline(d: dict) -> dict | None:
     wrappers; None when the file has no scalar headline."""
     if isinstance(d.get("parsed"), dict):
         d = d["parsed"]
+    # hot-spot observatory drill: the heat plane's load-rate separation
+    # (BENCH_HOTSPOT.json; unit "x" is direction-less — the scenario's
+    # Zipf skew sets the number, so it is trended but never gated).
+    # Checked BEFORE the generic value branch: the artifact also carries
+    # a top-level "value", which would bury the short series name under
+    # the long metric sentence
+    if isinstance(d.get("hotspot_separation"), (int, float)):
+        return {"value": float(d["hotspot_separation"]), "unit": "x",
+                "metric": "hotspot_separation"}
     if isinstance(d.get("value"), (int, float)):
         return {"value": float(d["value"]), "unit": d.get("unit", ""),
                 "metric": str(d.get("metric", ""))[:160]}

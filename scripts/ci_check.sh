@@ -8,6 +8,8 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 echo "== wukong-analyze (static gates) =="
+# all registered gates, incl. the telemetry trio (heat / slo /
+# placement-telemetry) that pin the observatory's decision surfaces
 python -m wukong_tpu.analysis  # exits non-zero on any gate violation
 
 echo "== tier-1 pytest (-m 'not slow') =="

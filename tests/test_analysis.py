@@ -48,7 +48,8 @@ def test_plugin_registry():
     assert set(plugin_names()) == {
         "no-bare-print", "batcher-route", "wal-hook", "guarded-by",
         "fault-sites", "config-readme", "metrics-readme", "error-taxonomy",
-        "heat-telemetry", "join-strategy", "slo-telemetry"}
+        "heat-telemetry", "join-strategy", "slo-telemetry",
+        "placement-telemetry"}
 
 
 def test_unknown_plugin_rejected():

@@ -1,0 +1,793 @@
+"""Placement observatory (ISSUE 11): metrics history, cluster event
+journal, and the observe-only migration advisor.
+
+Acceptance surface: the tsdb ring converts counters to windowed rates and
+histogram buckets to windowed percentiles (/history + `history` verb);
+lifecycle events land in one ordered journal with shard/tenant/qid
+correlation keys — a forced breaker-trip -> failover -> heal sequence
+reads as exactly that sequence, and SLO_BURN flight-recorder dumps
+reference their triggering event id; the PlacementAdvisor reads
+PLACEMENT_INPUTS through the tsdb trend windows and emits a literal
+MigrationPlan (hot-spot drill: top donor = the seeded hot shard,
+predicted bytes within 25% of the donor's checkpoint size, store
+bit-untouched); /healthz splits readiness from liveness; trace_dump_max
+bounds the dump dir; concurrent scrapes of every endpoint during serving
+are crash-free under the lockdep checker; and the placement-telemetry
+analysis gate holds the surface statically.
+"""
+
+import dataclasses
+import json
+import os
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from wukong_tpu.config import Global
+from wukong_tpu.engine.cpu import CPUEngine
+from wukong_tpu.loader.lubm import UB, VirtualLubmStrings, generate_lubm
+from wukong_tpu.obs import QueryTrace, get_recorder, get_registry
+from wukong_tpu.obs.events import EventJournal, emit_event, get_journal, render_events
+from wukong_tpu.obs.heat import get_heat
+from wukong_tpu.obs.placement import (
+    MIGRATION_PLAN_FIELDS,
+    MigrationPlan,
+    PlacementAdvisor,
+    ShardLineage,
+    get_advisor,
+    get_lineage,
+    render_plan,
+)
+from wukong_tpu.obs.tsdb import MetricsTSDB, get_tsdb, render_history
+from wukong_tpu.runtime import faults
+from wukong_tpu.runtime.faults import FaultPlan, FaultSpec
+from wukong_tpu.runtime.proxy import Proxy
+from wukong_tpu.store.gstore import build_partition
+
+pytestmark = pytest.mark.obs
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _lockdep_checked():
+    """The observatory suite runs fully lockdep-checked (the chaos-suite
+    posture): every lock created during the module feeds the
+    acquisition-order graph, so the concurrent-scrape test doubles as a
+    lock-order regression test. Teardown asserts zero cycles and zero
+    declared-leaf inversions."""
+    from wukong_tpu.analysis import lockdep
+
+    lockdep.install(True)
+    yield
+    try:
+        assert lockdep.cycles() == [], lockdep.cycles()
+        assert lockdep.leaf_violations() == [], lockdep.leaf_violations()
+    finally:
+        lockdep.install(False)
+
+
+@pytest.fixture(scope="module")
+def world():
+    triples, _ = generate_lubm(1, seed=42)
+    g = build_partition(triples, 0, 1)
+    ss = VirtualLubmStrings(1, seed=42)
+    return {"g": g, "ss": ss, "triples": triples}
+
+
+@pytest.fixture(scope="module")
+def proxy(world):
+    return Proxy(world["g"], world["ss"],
+                 CPUEngine(world["g"], world["ss"]))
+
+
+@pytest.fixture(autouse=True)
+def _hygiene(monkeypatch):
+    """Observatory knobs at defaults; every process-wide ring/ledger
+    clean; no fault plan leaks across tests."""
+    monkeypatch.setattr(Global, "enable_tracing", False)
+    monkeypatch.setattr(Global, "trace_dump_dir", "")
+    monkeypatch.setattr(Global, "enable_events", True)
+    monkeypatch.setattr(Global, "enable_tsdb", True)
+    get_recorder().clear()
+    get_heat().reset()
+    get_tsdb().reset()
+    get_journal().clear()
+    get_advisor().reset()
+    get_lineage().reset()
+    faults.clear()
+    yield
+    faults.clear()
+
+
+class _Mesh4:
+    devices = np.empty(4, dtype=object)
+
+
+def _sstore(world, n=4, replication_factor=1):
+    from wukong_tpu.parallel.sharded_store import ShardedDeviceStore
+
+    stores = [build_partition(world["triples"], i, n) for i in range(n)]
+    return ShardedDeviceStore(stores, _Mesh4(),
+                              replication_factor=replication_factor)
+
+
+# ---------------------------------------------------------------------------
+# tsdb: windowed rates, percentiles, retention, /history
+# ---------------------------------------------------------------------------
+
+def test_tsdb_counter_rate_over_window():
+    c = get_registry().counter("wukong_test_obsv_total", "t",
+                               labels=("who",))
+    ts = MetricsTSDB(interval_s=1, retention_s=600)
+    c.labels(who="a").inc(10)
+    ts.sample_once(now_us=1_000_000)
+    c.labels(who="a").inc(30)
+    c.labels(who="b").inc(5)
+    ts.sample_once(now_us=11_000_000)
+    # delta 30 over 10s, summed over matching label subsets
+    assert ts.rate("wukong_test_obsv_total", who="a") == pytest.approx(3.0)
+    by = ts.rate_by_label("wukong_test_obsv_total", "who")
+    assert by["a"] == pytest.approx(3.0)
+    assert by["b"] == pytest.approx(0.5)
+    # a single sample answers no rate
+    ts2 = MetricsTSDB()
+    ts2.sample_once()
+    assert ts2.rate("wukong_test_obsv_total") is None
+
+
+def test_tsdb_retention_evicts_old_samples():
+    ts = MetricsTSDB(interval_s=1, retention_s=10)
+    for t_s in (0, 4, 8, 12, 16, 20):
+        ts.sample_once(now_us=t_s * 1_000_000)
+    # everything older than 20 - 10 = 10s is gone
+    assert len(ts) == 3  # t = 12, 16, 20
+    assert ts.span_s() == pytest.approx(8.0)
+
+
+def test_tsdb_histogram_quantile_windowed():
+    h = get_registry().histogram("wukong_test_obsv_lat_us", "t")
+    ts = MetricsTSDB(interval_s=1, retention_s=600)
+    h.observe(50, count=100)  # pre-window history must not leak in
+    ts.sample_once(now_us=1_000_000)
+    for v in (200, 200, 200, 50_000):
+        h.observe(v)
+    ts.sample_once(now_us=2_000_000)
+    p50 = ts.quantile("wukong_test_obsv_lat_us", 0.5)
+    p99 = ts.quantile("wukong_test_obsv_lat_us", 0.99)
+    # 3 of 4 in-window observations land in the (100, 400] bucket
+    assert 100 < p50 <= 400
+    assert p99 > 6_400  # the 50ms outlier dominates the tail
+    # the 100 pre-window observations at 50us would have dragged p50
+    # under 100 if the window leaked
+    assert ts.quantile("wukong_test_obsv_lat_us", 0.5,
+                       window_s=1e9) is not None
+
+
+def test_history_report_and_render():
+    c = get_registry().counter("wukong_test_obsv_total", "t",
+                               labels=("who",))
+    ts = get_tsdb()
+    ts.sample_once(now_us=1_000_000)
+    c.labels(who="hist").inc(42)
+    ts.sample_once(now_us=2_000_000)
+    text, js = render_history(8)
+    assert "COUNTER RATES" in text and "GAUGES" in text
+    assert js["samples"] == 2
+    names = [r["name"] for r in js["counters"]]
+    assert "wukong_test_obsv_total" in names
+
+
+# ---------------------------------------------------------------------------
+# event journal: ring, ids, correlation keys, JSONL, knob
+# ---------------------------------------------------------------------------
+
+def test_event_journal_ring_and_filters():
+    j = EventJournal(capacity=4)
+    ids = [j.emit("breaker.trip", shard=i % 2, key=str(i))
+           for i in range(6)]
+    evs = j.last()
+    assert len(evs) == 4  # bounded ring keeps the newest
+    assert [e.seq for e in evs] == sorted(e.seq for e in evs)  # ordered
+    assert all(e.event_id.startswith("ev") for e in evs)
+    assert ids[-1] == evs[-1].event_id
+    assert {e.shard for e in j.last(shard=1)} == {1}
+    assert j.find(ids[-1]) is not None
+    assert j.find(ids[0]) is None  # evicted
+    assert j.counts() == {"breaker.trip": 4}
+
+
+def test_event_journal_jsonl_mirror(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    j = EventJournal(capacity=8, log_path=path)
+    eid = j.emit("slo.burn", tenant="gold", fast_burn=15.0)
+    j.emit("wal.rotate", path="seg")
+    lines = [json.loads(ln) for ln in open(path).read().splitlines()]
+    assert len(lines) == 2
+    assert lines[0]["event_id"] == eid
+    assert lines[0]["tenant"] == "gold"
+    assert lines[0]["attrs"]["fast_burn"] == 15.0
+    j.close()
+
+
+def test_journal_jsonl_failed_write_closes_handle(tmp_path):
+    # a full disk drops the mirror handle — but must CLOSE it, not leak
+    # the fd to GC timing in the middle of the very storm filling the disk
+    path = str(tmp_path / "events.jsonl")
+    j = EventJournal(capacity=8, log_path=path)
+    j.emit("unit.probe", shard=1)  # opens the handle
+
+    class _Boom:
+        closed = False
+
+        def write(self, s):
+            raise OSError(28, "No space left on device")
+
+        def close(self):
+            self.closed = True
+
+    boom = _Boom()
+    j._fh = boom
+    eid = j.emit("unit.probe", shard=2)  # write fails, emit still journals
+    assert eid is not None and j.find(eid) is not None
+    assert boom.closed and j._fh is None
+
+
+def test_emit_event_knob_off(monkeypatch):
+    monkeypatch.setattr(Global, "enable_events", False)
+    assert emit_event("breaker.trip", shard=1) is None
+    assert get_journal().counts() == {}
+
+
+# ---------------------------------------------------------------------------
+# acceptance: breaker-trip -> failover -> heal as an ordered, shard-
+# correlated timeline (the forced sequence of the ISSUE's criterion)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_breaker_failover_heal_event_correlation(world, monkeypatch):
+    from wukong_tpu.runtime.recovery import RecoveryManager
+    from wukong_tpu.store.persist import clone_gstore
+
+    monkeypatch.setattr(Global, "retry_base_ms", 1)
+    monkeypatch.setattr(Global, "retry_max_ms", 2)
+    sstore = _sstore(world)
+    sstore.replicas = {0: [(1, clone_gstore(sstore.stores[0]))]}
+    faults.install(FaultPlan([FaultSpec("dist.shard_fetch", "shard_down",
+                                        shard=0)], seed=0))
+    # shard_down is non-retryable: one breaker failure per fetch; the
+    # default threshold (3) trips on the third fetch. Every fetch still
+    # serves from the replica (failover), results complete.
+    for _ in range(4):
+        out, ok = sstore._fetch_shard(0, lambda g: np.arange(4), "t")
+        assert ok
+    faults.clear()  # "the dead host is replaced"
+    rm = RecoveryManager(lambda: list(sstore.stores), sstore=sstore)
+    healed = rm.heal_once(force=True)
+    assert healed == [0]
+
+    evs = get_journal().last(shard=0)
+    kinds = [e.kind for e in evs]
+    assert all(e.shard == 0 for e in evs)
+    # the ordered story: ONE failover edge (not one event per fetch —
+    # a down primary under load must not churn the ring), the trip, heal
+    assert kinds.count("shard.failover") == 1
+    assert "shard.failover" in kinds and "breaker.trip" in kinds
+    assert "shard.rebuild" in kinds and "shard.heal" in kinds
+    assert "breaker.close" in kinds
+    assert kinds.index("breaker.trip") < kinds.index("breaker.close")
+    # promote closes the breaker, then journals the rebuild + the heal
+    assert kinds.index("breaker.close") <= kinds.index("shard.rebuild")
+    assert kinds.index("shard.rebuild") <= kinds.index("shard.heal")
+    # the journal's seq order IS chronological order
+    assert [e.seq for e in evs] == sorted(e.seq for e in evs)
+    # /events renders the same filtered timeline
+    text, js = render_events(shard=0)
+    assert "shard.failover" in text and "breaker.trip" in text
+    # the lineage ledger saw the failover and the heal
+    rep = get_lineage().report()
+    assert rep[0]["last_failover_us"] > 0
+    assert rep[0]["last_heal_us"] > 0
+
+
+# ---------------------------------------------------------------------------
+# acceptance: SLO_BURN / LATENCY_REGRESSION dumps reference their
+# triggering event id
+# ---------------------------------------------------------------------------
+
+def test_slo_burn_dump_references_event_id(tmp_path, monkeypatch):
+    from wukong_tpu.obs.slo import SLOSpec, SLOTracker
+
+    monkeypatch.setattr(Global, "trace_dump_dir", str(tmp_path))
+    monkeypatch.setattr(Global, "slo_dump_cooldown_s", 3600)
+    t = SLOTracker(window=128)
+    t.register(SLOSpec("gold", 0.95, 0.0, 0.999))
+    tr = QueryTrace(kind="query", tenant="gold")
+    tr.finish("ERROR")
+    verdicts = [t.observe("gold", 1000, ok=False, trace=tr)
+                for _ in range(40)]
+    [v] = [v for v in verdicts if v is not None]
+    assert v["event_id"]  # the verdict names its journal event
+    ev = get_journal().find(v["event_id"])
+    assert ev is not None and ev.kind == "slo.burn" and ev.tenant == "gold"
+    meta = [m for m in get_recorder().dump_meta if m["reason"] == "SLO_BURN"]
+    assert len(meta) == 1 and meta[0]["event_id"] == v["event_id"]
+    # the on-disk dump JSON cross-links too
+    doc = json.load(open(tmp_path / f"trace_{tr.trace_id}.json"))
+    assert doc["event_id"] == v["event_id"]
+
+
+def test_latency_regression_dump_references_event_id(monkeypatch):
+    from wukong_tpu.obs.profile import LatencyAttributor
+
+    monkeypatch.setattr(Global, "attribution_min_samples", 8)
+    attr = LatencyAttributor(window=64)
+
+    def fake(total_us):
+        tr = QueryTrace(kind="query", tenant="acme")
+        tr.finish("SUCCESS")
+        tr.t1_us = tr.t0_us + total_us
+        return tr
+
+    for _ in range(16):
+        assert attr.observe(fake(1_000), "tmpl") is None
+    v = attr.observe(fake(100_000), "tmpl")  # >> baseline p95
+    assert v is not None and v["reason"] == "P95_DRIFT"
+    assert v["event_id"]
+    ev = get_journal().find(v["event_id"])
+    assert ev is not None and ev.kind == "latency.regression"
+    assert get_recorder().dump_meta[-1]["event_id"] == v["event_id"]
+
+
+def test_auto_dump_journals_trace_dump_event():
+    """A dump with no upstream trigger (slow query / failure code) still
+    lands one correlated journal entry of its own."""
+    tr = QueryTrace(kind="query", tenant="acme")
+    tr.finish("SUCCESS")
+    get_recorder().dump(tr, "SLOW_QUERY")
+    meta = get_recorder().dump_meta[-1]
+    assert meta["event_id"]
+    ev = get_journal().find(meta["event_id"])
+    assert ev is not None and ev.kind == "trace.dump"
+    assert ev.attrs["reason"] == "SLOW_QUERY" and ev.tenant == "acme"
+
+
+# ---------------------------------------------------------------------------
+# satellite: flight-recorder dump-dir retention (trace_dump_max)
+# ---------------------------------------------------------------------------
+
+def test_trace_dump_dir_retention(tmp_path, monkeypatch):
+    monkeypatch.setattr(Global, "trace_dump_dir", str(tmp_path))
+    monkeypatch.setattr(Global, "trace_dump_max", 3)
+    traces = []
+    for _ in range(6):
+        tr = QueryTrace(kind="query")
+        tr.finish("SUCCESS")
+        get_recorder().dump(tr, "SLOW_QUERY")
+        traces.append(tr.trace_id)
+    names = sorted(os.listdir(tmp_path))
+    assert len(names) == 3
+    # the newest three survive, the oldest were evicted
+    assert names == sorted(f"trace_{t}.json" for t in traces[-3:])
+    # 0 = unbounded (the legacy behavior)
+    monkeypatch.setattr(Global, "trace_dump_max", 0)
+    for _ in range(4):
+        tr = QueryTrace(kind="query")
+        tr.finish("SUCCESS")
+        get_recorder().dump(tr, "SLOW_QUERY")
+    assert len(os.listdir(tmp_path)) == 7
+
+
+# ---------------------------------------------------------------------------
+# WAL lifecycle events: rotation + torn tail
+# ---------------------------------------------------------------------------
+
+def test_wal_rotation_and_torn_tail_events(tmp_path):
+    from wukong_tpu.store.wal import WriteAheadLog
+
+    wal = WriteAheadLog(str(tmp_path), segment_bytes=256)
+    for i in range(6):
+        wal.append("insert", triples=np.zeros((4, 3), dtype=np.int64),
+                   dedup=False)
+    wal.close()
+    assert get_journal().counts().get("wal.rotate", 0) >= 1
+    # tear the tail segment: re-opening truncates AND journals it
+    segs = sorted(p for p in os.listdir(tmp_path) if p.endswith(".log"))
+    tail = os.path.join(str(tmp_path), segs[-1])
+    with open(tail, "r+b") as f:
+        f.truncate(os.path.getsize(tail) - 3)
+    WriteAheadLog(str(tmp_path), segment_bytes=256)
+    torn = get_journal().last(kind="wal.torn_tail")
+    assert torn and torn[-1].attrs["where"] == "open"
+
+
+# ---------------------------------------------------------------------------
+# acceptance: the hot-spot drill end to end (advisor + observe-only proof)
+# ---------------------------------------------------------------------------
+
+def test_hotspot_drill_advisor_plan(world, proxy, tmp_path):
+    """ROADMAP item 3's acceptance fixture: the Zipfian scenario's
+    MigrationPlan names the seeded hot shard as top donor, predicts move
+    bytes within 25% of the donor's measured checkpoint size, and leaves
+    the store bit-untouched (store-version equality)."""
+    from wukong_tpu.runtime.emulator import Emulator
+    from wukong_tpu.runtime.recovery import RecoveryManager
+
+    sstore = _sstore(world)
+    rm = RecoveryManager(lambda: list(sstore.stores), sstore=sstore,
+                         ckpt_dir=str(tmp_path))
+    ckpt = rm.checkpoint()
+    assert get_journal().counts().get("checkpoint.write") == 1
+    rep = Emulator(proxy).run_hotspot(n_ops=800, zipf_a=1.6, seed=7,
+                                      sstore=sstore)
+    assert rep["ranked"][0] == rep["hot"]
+    plan = rep["plan"]
+    assert plan is not None and rep["plan_donor_is_hot"]
+    assert plan["donor_shard"] == rep["hot"]
+    assert rep["store_untouched"]
+    # predicted bytes come from the measured checkpoint part size and
+    # land within the 25% acceptance band of the actual file
+    assert plan["bytes_source"] == "checkpoint"
+    from wukong_tpu.store.persist import checkpoint_part_path
+
+    actual = os.path.getsize(checkpoint_part_path(ckpt, rep["hot"]))
+    assert abs(plan["predicted_move_bytes"] - actual) <= 0.25 * actual
+    # the band's real teeth: the never-checkpointed fallback (the live
+    # store's memory_bytes estimate) must ALSO stay within 25% of what
+    # a checkpoint actually measures — the checkpoint path is exact by
+    # construction, the estimate path is the one that can drift
+    est = sstore.stores[rep["hot"]].memory_bytes()
+    assert abs(est - actual) <= 0.25 * actual
+    # the recipient is a host that does not already hold the donor
+    assert plan["recipient_host"] != plan["donor_shard"]
+    # the advisor's read surface is the declared placement input
+    assert plan["inputs"]["metric"] == "wukong_shard_heat_fetches_total"
+    # /plan (no fresh sweep) surfaces the scenario's plan
+    text, js = render_plan(advise=False)
+    assert f"donor shard       {plan['donor_shard']}" in text
+    assert js["status"]["plan"]["plan_id"] == plan["plan_id"]
+
+
+def test_advisor_balanced_emits_no_plan(world):
+    ts = MetricsTSDB(interval_s=1, retention_s=600)
+    sstore = _sstore(world)
+    adv = PlacementAdvisor(sstore=sstore, tsdb=ts,
+                           lineage=ShardLineage())
+    ts.sample_once()
+    for i in range(4):
+        for _ in range(10):
+            sstore._fetch_shard(i, lambda g: np.arange(8), "t")
+    # a RETIRED world's shard label (9 does not exist in this 4-shard
+    # store) must not skew the live topology's imbalance score
+    get_registry().counter("wukong_shard_heat_fetches_total",
+                           labels=("shard", "kind")).labels(
+        shard=9, kind="primary").inc(500)
+    ts.sample_once()
+    assert adv.advise_once() is None
+    assert adv.status()["decision"] == "balanced"
+    assert adv.status()["imbalance"] < 2.0
+
+
+def test_advisor_no_samples_no_data(world):
+    adv = PlacementAdvisor(sstore=_sstore(world),
+                           tsdb=MetricsTSDB(), lineage=ShardLineage())
+    assert adv.advise_once() is None
+    assert adv.status()["decision"] == "no_data"
+
+
+def test_advisor_no_store_refuses_stale_labels(world):
+    # heat labels outlive the stores that minted them: an on-demand sweep
+    # (/plan?sweep=1, the console verb) after the world retired must not
+    # turn the dead world's residual window rates into a MigrationPlan
+    ts = MetricsTSDB(interval_s=1, retention_s=600)
+    adv = PlacementAdvisor(tsdb=ts, lineage=ShardLineage())
+    sstore = _sstore(world)
+    ts.sample_once()
+    for _ in range(50):
+        sstore._fetch_shard(3, lambda g: np.arange(8), "t")
+    ts.sample_once()
+    del sstore  # the world retires; its label rates stay in the window
+    assert adv.advise_once() is None
+    assert adv.status()["decision"] == "no_store"
+
+
+def test_gstore_digest_detects_raw_array_write(world):
+    # the hotspot drill's observe-only proof: a raw in-place write (no
+    # version bump) must flip the digest, and restoring it must restore
+    # the digest (deterministic walk)
+    from wukong_tpu.store.persist import gstore_digest
+
+    g = build_partition(world["triples"], 0, 4)
+    d0 = gstore_digest(g)
+    assert gstore_digest(g) == d0
+    arr = next(a for a in g.index.values() if a.size)
+    arr[0] += 1
+    assert gstore_digest(g) != d0
+    arr[0] -= 1
+    assert gstore_digest(g) == d0
+
+
+def test_advisor_colocated_donor_on_overloaded_host():
+    # once a control plane co-locates shards, the trigger is HOST
+    # imbalance — the donor must come from the overloaded host, not be
+    # the globally hottest shard (which can sit on a healthy host)
+    lin = ShardLineage()
+    lin.note_placement(0, 0)
+    lin.note_placement(1, 0)  # host 0 serves shards 0+1: 60/s total
+    lin.note_placement(2, 1)  # host 1 serves the hottest SHARD: 31/s
+    lin.note_placement(3, 2)
+    lin.note_placement(4, 3)
+    adv = PlacementAdvisor(lineage=lin)
+    rates = {0: 30.0, 1: 30.0, 2: 31.0, 3: 0.0, 4: 0.0}
+    decision, imb, plan = adv._decide(rates, 300.0, lin)
+    assert decision == "planned"
+    assert plan.donor_shard in (0, 1)  # NOT shard 2
+    assert plan.recipient_host not in (0,)  # off the overloaded host
+    assert plan.imbalance_after < plan.imbalance_before
+
+
+def test_migration_plan_fields_match_registry():
+    assert set(MIGRATION_PLAN_FIELDS) == {
+        f.name for f in dataclasses.fields(MigrationPlan)}
+
+
+# ---------------------------------------------------------------------------
+# /healthz readiness split + the observatory endpoints
+# ---------------------------------------------------------------------------
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _get(port, path):
+    return urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=5).read().decode()
+
+
+def test_observatory_endpoints_and_healthz(world, monkeypatch):
+    from wukong_tpu.obs import (
+        maybe_start_metrics_http,
+        register_health_source,
+        stop_metrics_http,
+    )
+
+    get_tsdb().sample_once()
+    get_tsdb().sample_once()
+    emit_event("shard.degraded", shard=2)
+    port = _free_port()
+    assert maybe_start_metrics_http(port=port) is not None
+    try:
+        assert "COUNTER RATES" in _get(port, "/history")
+        js = json.loads(_get(port, "/history.json?k=4"))
+        assert js["samples"] >= 2
+        body = _get(port, "/events")
+        assert "shard.degraded" in body
+        ejs = json.loads(_get(port, "/events.json"))
+        assert ejs["counts"].get("shard.degraded") == 1
+        assert "wukong-plan" in _get(port, "/plan")
+        # healthz: live + ready by default (JSON body, 200)
+        h = json.loads(_get(port, "/healthz"))
+        assert h["live"] is True and h["ready"] is True
+        # a degraded probe flips readiness; liveness stays 200 until the
+        # knob opts into load-balancer drain semantics
+        register_health_source("test-probe", lambda: {"bad": 1})
+        try:
+            h = json.loads(_get(port, "/healthz"))
+            assert h["ready"] is False
+            assert h["degraded"]["test-probe"] == {"bad": 1}
+            monkeypatch.setattr(Global, "health_ready_503", True)
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(port, "/healthz")
+            assert ei.value.code == 503
+            assert json.loads(ei.value.read())["ready"] is False
+        finally:
+            register_health_source("test-probe", lambda: None)
+    finally:
+        stop_metrics_http()
+
+
+def test_healthz_reports_open_breakers(world, monkeypatch):
+    from wukong_tpu.obs import health_report
+    from wukong_tpu.runtime.monitor import Monitor
+
+    monkeypatch.setattr(Global, "retry_base_ms", 1)
+    monkeypatch.setattr(Global, "retry_max_ms", 2)
+    sstore = _sstore(world)
+    mon = Monitor()
+    mon.attach_breaker("dist.shard", sstore.breaker)
+    faults.install(FaultPlan([FaultSpec("dist.shard_fetch", "shard_down",
+                                        shard=1)], seed=0))
+    for _ in range(4):  # trips the per-shard breaker (threshold 3)
+        sstore._fetch_shard(1, lambda g: np.arange(4), "t")
+    rep = health_report()
+    assert rep["live"] and not rep["ready"]
+    assert rep["degraded"]["open_breakers"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# satellite: concurrent httpd scrapes while the serving loop runs
+# ---------------------------------------------------------------------------
+
+def test_concurrent_scrapes_during_serving(world, proxy):
+    """Parallel /metrics, /top, /slo, /history, /events scrapes while
+    closed-loop serving threads run: crash-free, every response 200, and
+    the module's lockdep fixture asserts no ordering findings."""
+    from wukong_tpu.obs import maybe_start_metrics_http, stop_metrics_http
+    from wukong_tpu.types import OUT
+
+    ss, g = world["ss"], world["g"]
+    pid = ss.str2id(f"<{UB}advisor>")
+    anchors = np.asarray(g.get_index(pid, OUT))[:8]
+    texts = [f"SELECT ?s WHERE {{ ?s <{UB}advisor> "
+             f"{ss.id2str(int(a))} . }}" for a in anchors]
+    port = _free_port()
+    assert maybe_start_metrics_http(port=port) is not None
+    stop = threading.Event()
+    errors: list = []
+
+    def serve(k):
+        i = 0
+        while not stop.is_set():
+            try:
+                proxy.serve_query(texts[i % len(texts)], blind=True)
+            except Exception as e:
+                errors.append(("serve", repr(e)))
+            i += 1
+
+    def scrape(path):
+        n = 0
+        while not stop.is_set():
+            try:
+                _get(port, path)
+            except Exception as e:
+                errors.append((path, repr(e)))
+            n += 1
+            get_tsdb().sample_once()
+
+    paths = ["/metrics", "/top", "/slo", "/history", "/events"]
+    threads = ([threading.Thread(target=serve, args=(k,), daemon=True)
+                for k in range(2)]
+               + [threading.Thread(target=scrape, args=(p,), daemon=True)
+                  for p in paths])
+    try:
+        for t in threads:
+            t.start()
+        time.sleep(1.5)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        stop_metrics_http()
+    assert errors == [], errors[:4]
+    # bounded memory: the ring's count cap holds even though the scrape
+    # threads sampled far faster than the nominal interval
+    assert len(get_tsdb()) <= int(
+        Global.tsdb_retention_s / max(Global.tsdb_interval_s, 1)) + 8
+
+
+# ---------------------------------------------------------------------------
+# Monitor lines + console verbs
+# ---------------------------------------------------------------------------
+
+def test_monitor_events_and_placement_lines():
+    from wukong_tpu.runtime.monitor import Monitor
+
+    mon = Monitor()
+    assert mon.events_lines() == []  # quiet while nothing happened
+    assert mon.placement_lines() == []
+    emit_event("breaker.trip", shard=3, key="3")
+    emit_event("shard.failover", shard=3, replica=1)
+    [line] = mon.events_lines()
+    assert line.startswith("Events[") and "shard.failover:1" in line
+    adv = get_advisor()
+    with adv._lock:
+        adv._last_plan = MigrationPlan(
+            plan_id="mp1", t_us=1, donor_shard=3, recipient_host=1,
+            predicted_move_bytes=2 << 20, bytes_source="checkpoint",
+            donor_rate_per_s=9.0, mean_rate_per_s=3.0,
+            imbalance_before=3.0, imbalance_after=1.5, window_s=300.0)
+    [pl] = mon.placement_lines()
+    assert "donor shard 3 -> host 1" in pl and "2.0 MiB" in pl
+
+
+def test_console_config_flip_starts_sampler(proxy, monkeypatch):
+    """enable_tsdb is runtime-mutable BOTH ways: flipping it on via the
+    console's `config -s` must start the sampler thread, not wait for a
+    process restart (the running-thread direction idles per tick)."""
+    from wukong_tpu.obs import tsdb as tsdb_mod
+    from wukong_tpu.obs.tsdb import stop_tsdb
+    from wukong_tpu.runtime.console import Console
+
+    monkeypatch.setattr(Global, "enable_tsdb", False)
+    stop_tsdb()
+    assert tsdb_mod._sampler is None
+    con = Console(proxy)
+    con.run_command("config -s enable_tsdb true")
+    try:
+        assert Global.enable_tsdb is True
+        assert tsdb_mod._sampler is not None  # started by the flip
+    finally:
+        stop_tsdb()
+
+
+def test_console_verbs(proxy, capsys):
+    from wukong_tpu.runtime.console import Console
+
+    get_tsdb().sample_once()
+    get_tsdb().sample_once()
+    emit_event("checkpoint.write", parts=4)
+    con = Console(proxy)
+    con.run_command("history -k 4")
+    con.run_command("events")
+    con.run_command("plan -n")
+    out = capsys.readouterr().out
+    assert "wukong-history" in out
+    assert "checkpoint.write" in out
+    assert "wukong-plan" in out
+    con.run_command("events -j")
+    assert "counts" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# the placement-telemetry analysis gate (pos/neg fixtures)
+# ---------------------------------------------------------------------------
+
+def test_placement_telemetry_gate_fixtures(tmp_path):
+    from wukong_tpu.analysis import run_analysis
+
+    def write(tree: dict) -> str:
+        root = tmp_path / "pkg"
+        for rel, src in tree.items():
+            p = root / rel
+            p.parent.mkdir(parents=True, exist_ok=True)
+            p.write_text(src)
+        return str(root)
+
+    bad = write({
+        "obs/heat.py": "PLACEMENT_INPUTS = {'fetches': 'wukong_ok_total'}\n",
+        "obs/placement.py": (
+            "MIGRATION_PLAN_FIELDS = ('donor', 'stale_entry')\n"
+            "class MigrationPlan:\n"
+            "    donor: int\n"
+            "    extra: int\n"
+            "def advise(ts):\n"
+            "    return ts.rate_by_label('wukong_rogue_total', 'shard')\n"
+            "class A:\n"
+            "    def __init__(self):\n"
+            "        self.plans = {}\n"
+            "        self.lock = make_lock('placement.x')\n")})
+    out = run_analysis(bad, plugins=["placement-telemetry"])
+    msgs = "\n".join(str(v) for v in out)
+    assert "stale_entry" in msgs      # registry entry with no field
+    assert "'extra'" in msgs          # field missing from the registry
+    assert "wukong_rogue_total" in msgs  # undeclared trend read
+    assert "A.plans" in msgs          # unannotated shared structure
+    assert "placement.x" in msgs      # undeclared leaf lock
+
+    good = write({
+        "obs/heat.py": "PLACEMENT_INPUTS = {'fetches': 'wukong_ok_total'}\n",
+        "obs/placement.py": (
+            "MIGRATION_PLAN_FIELDS = ('donor',)\n"
+            "declare_leaf('placement.x')\n"
+            "class MigrationPlan:\n"
+            "    donor: int\n"
+            "def advise(ts):\n"
+            "    return ts.rate_by_label('wukong_ok_total', 'shard')\n"
+            "class A:\n"
+            "    def __init__(self):\n"
+            "        self.plans = {}  # guarded by: _lock\n"
+            "        self.lock = make_lock('placement.x')\n")})
+    assert run_analysis(good, plugins=["placement-telemetry"]) == []
+
+
+def test_repo_placement_gate_clean():
+    from wukong_tpu.analysis import run_analysis
+
+    pkg = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "wukong_tpu")
+    assert run_analysis(pkg, plugins=["placement-telemetry"]) == []

@@ -192,6 +192,7 @@ def _load_builtin_plugins() -> None:
         guarded,
         joingate,
         obs_gates,
+        placegate,
         slogate,
         telemetry,
     )

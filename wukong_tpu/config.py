@@ -170,6 +170,47 @@ class GlobalConfig:
     # rows shown per section in the /top report and the `top` console verb
     top_k: int = 8
 
+    # ---- placement observatory (obs/tsdb.py, obs/events.py,
+    # obs/placement.py; all mutable) ----
+    # metrics time-series ring: sample MetricsRegistry.snapshot() every
+    # tsdb_interval_s seconds into a bounded ring tsdb_retention_s deep,
+    # answering windowed rate / percentile / range queries (/history, the
+    # `history` verb, and the PlacementAdvisor's trend reads). Default ON:
+    # one snapshot per interval is far off any hot path (overhead guard in
+    # BENCH_SERVE.json detail.observatory).
+    enable_tsdb: bool = True
+    tsdb_interval_s: int = 5
+    tsdb_retention_s: int = 900
+    # structured cluster-event journal: breaker trips, failovers, heals,
+    # WAL rotations, checkpoint writes, SLO burns, and latency regressions
+    # land in a bounded ring (events_ring entries) with shard/tenant/qid
+    # correlation keys (/events, the `events` verb, Monitor Events[...]).
+    # events_log_path additionally mirrors every event to a JSONL file
+    # ("" = in-memory only). Off degrades every emitter to one knob check.
+    enable_events: bool = True
+    events_ring: int = 512
+    events_log_path: str = ""
+    # observe-only placement advisor: read the heat plane's PLACEMENT_INPUTS
+    # through the tsdb trend window (placement_window_s seconds), score
+    # max/mean host load-rate imbalance, and emit a MigrationPlan artifact
+    # when it reaches placement_imbalance_x (never touching the store).
+    # placement_interval_s > 0 runs the advisory loop in the background;
+    # 0 (default) advises on demand only (/plan, the `plan` verb).
+    placement_interval_s: int = 0
+    placement_window_s: int = 300
+    # float: fractional thresholds like 1.5x are legitimate for a
+    # max/mean ratio
+    placement_imbalance_x: float = 2.0
+    # flight-recorder dump-dir retention: keep at most this many
+    # trace_*.json files in trace_dump_dir, evicting oldest (0 = unbounded
+    # — the pre-observatory behavior; auto-dump storms then grow the dir
+    # without limit)
+    trace_dump_max: int = 256
+    # /healthz readiness semantics: when on, a degraded process (open
+    # breakers, degraded/failover shards, dead pool engines) answers 503
+    # so a load balancer drains it; liveness stays 200 either way when off
+    health_ready_503: bool = False
+
     # ---- tenant-aware SLO plane (obs/slo.py; all mutable) ----
     # per-tenant accounting at the proxy reply point: tenant-labeled reply
     # counters/latency histograms, per-tenant in-flight + arrival-rate
@@ -322,6 +363,8 @@ class GlobalConfig:
             setattr(self, key, value.strip().lower() in ("1", "true", "yes", "on"))
         elif isinstance(cur, int):
             setattr(self, key, int(value))
+        elif isinstance(cur, float):
+            setattr(self, key, float(value))
         else:
             setattr(self, key, value.strip())
 

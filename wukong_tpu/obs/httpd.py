@@ -33,21 +33,72 @@ from wukong_tpu.utils.logger import log_info, log_warn
 
 _lock = threading.Lock()
 _server: "ThreadingHTTPServer | None" = None  # guarded by: _lock
+# named readiness probes registered by subsystem owners (the proxy
+# registers its sharded store's degraded/failover view); each fn returns
+# a truthy payload when degraded, falsy when healthy
+_health_sources: dict = {}  # guarded by: _lock
+
+
+def register_health_source(name: str, fn) -> None:
+    """Register a readiness probe for /healthz (idempotent by name)."""
+    with _lock:
+        _health_sources[str(name)] = fn
+
+
+def health_report() -> dict:
+    """The /healthz body: liveness (the process answered) split from
+    readiness (nothing degraded). Built-in probes: open circuit breakers
+    (the ``wukong_breaker_open`` pull gauge, read point-wise — a
+    load-balancer poll must not pay a full registry snapshot) and dead
+    pool engines; registered sources add subsystem views
+    (degraded/failover shards)."""
+    degraded: dict = {}
+    fam = get_registry().gauge(
+        "wukong_breaker_open", "Breaker keys not in the closed state",
+        labels=("name",))
+    fam._refresh()
+    open_b = sum(ch.value for _lv, ch in fam._series())
+    if open_b:
+        degraded["open_breakers"] = int(open_b)
+    try:
+        from wukong_tpu.runtime.scheduler import dead_engine_count
+
+        dead = dead_engine_count()
+    except Exception:
+        dead = 0
+    if dead:
+        degraded["dead_engines"] = int(dead)
+    with _lock:
+        sources = dict(_health_sources)
+    for name, fn in sources.items():
+        try:
+            v = fn()
+        except Exception as e:  # a broken probe reads as degraded, loudly
+            v = f"probe failed: {e!r}"
+        if v:
+            degraded[name] = v
+    return {"live": True, "ready": not degraded, "degraded": degraded}
 
 
 class _MetricsHandler(BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802 (stdlib handler naming)
         path, _, query = self.path.partition("?")
+        status = 200
         if path in ("/metrics", "/"):
             body = get_registry().render_prometheus().encode()
             ctype = "text/plain; version=0.0.4; charset=utf-8"
         elif path == "/metrics.json":
             body = json.dumps(get_registry().snapshot(), indent=1).encode()
             ctype = "application/json"
-        elif path in ("/top", "/top.json", "/slo", "/slo.json"):
-            # top(1) for shards / templates / lanes (obs/profile.py), and
-            # the tenant SLO + overload-signal report (obs/slo.py); ?k=N
-            # widens or narrows every section
+        elif path in ("/top", "/top.json", "/slo", "/slo.json",
+                      "/history", "/history.json", "/events",
+                      "/events.json", "/plan", "/plan.json"):
+            # top(1) for shards / templates / lanes (obs/profile.py), the
+            # tenant SLO + overload-signal report (obs/slo.py), and the
+            # observatory plane: metrics trend windows (obs/tsdb.py), the
+            # cluster event journal (obs/events.py), and the observe-only
+            # placement advisor (obs/placement.py); ?k=N widens or
+            # narrows every section
             k = None
             for part in query.split("&"):
                 if part.startswith("k="):
@@ -59,22 +110,46 @@ class _MetricsHandler(BaseHTTPRequestHandler):
                 from wukong_tpu.obs.slo import render_slo
 
                 text, js = render_slo(k)
+            elif path.startswith("/history"):
+                from wukong_tpu.obs.tsdb import render_history
+
+                text, js = render_history(k)
+            elif path.startswith("/events"):
+                from wukong_tpu.obs.events import render_events
+
+                text, js = render_events(k)
+            elif path.startswith("/plan"):
+                # read-only by default: a monitoring poller must not run
+                # advisory sweeps (inflating the decision counter) on
+                # every scrape — ?sweep=1 opts into a fresh observe-only
+                # sweep (the console `plan` verb's default)
+                from wukong_tpu.obs.placement import render_plan
+
+                text, js = render_plan(
+                    advise="sweep=1" in query.split("&"))
             else:
                 from wukong_tpu.obs.profile import render_top
 
                 text, js = render_top(k)
             if path.endswith(".json"):
-                body = json.dumps(js, indent=1).encode()
+                body = json.dumps(js, indent=1, default=str).encode()
                 ctype = "application/json"
             else:
                 body = text.encode()
                 ctype = "text/plain; charset=utf-8"
         elif path == "/healthz":
-            body, ctype = b"ok\n", "text/plain"
+            # liveness vs readiness: the body always reports both; the
+            # status degrades to 503 only when health_ready_503 opts into
+            # load-balancer drain semantics
+            rep = health_report()
+            body = json.dumps(rep, indent=1, default=str).encode()
+            ctype = "application/json"
+            if not rep["ready"] and Global.health_ready_503:
+                status = 503
         else:
             self.send_error(404)
             return
-        self.send_response(200)
+        self.send_response(status)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
@@ -110,7 +185,8 @@ def maybe_start_metrics_http(port: int | None = None):
         t.start()
         _server = srv
         log_info(f"metrics http endpoint on :{srv.server_address[1]} "
-                 "(/metrics, /metrics.json, /top, /slo)")
+                 "(/metrics, /metrics.json, /top, /slo, /history, "
+                 "/events, /plan, /healthz)")
         return srv
 
 

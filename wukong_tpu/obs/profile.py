@@ -400,7 +400,15 @@ class LatencyAttributor:
         _M_SAMPLES.inc()
         if verdict is not None:
             _M_REGRESS.labels(template=template).inc()
-            get_recorder().dump(trace, "LATENCY_REGRESSION")
+            # journal first so the dump references its triggering event
+            from wukong_tpu.obs.events import emit_event
+
+            eid = emit_event("latency.regression",
+                             tenant=verdict["tenant"], template=template,
+                             reason=verdict["reason"],
+                             total_us=verdict["total_us"])
+            verdict["event_id"] = eid
+            get_recorder().dump(trace, "LATENCY_REGRESSION", event_id=eid)
         return verdict
 
     # ------------------------------------------------------------------

@@ -38,6 +38,10 @@ class FlightRecorder:
         self._ring: deque[QueryTrace] = deque(
             maxlen=capacity or max(int(Global.trace_ring), 1))  # guarded by: _lock
         self.dumps: deque[tuple[str, QueryTrace]] = deque(maxlen=64)  # guarded by: _lock
+        # per-dump metadata incl. the cluster-event id the dump references
+        # (the triggering event — SLO_BURN dumps carry their slo.burn
+        # event's id — else a trace.dump event emitted here)
+        self.dump_meta: deque = deque(maxlen=64)  # guarded by: _lock
         reg = get_registry()
         self._m_recorded = reg.counter(
             "wukong_traces_recorded_total", "Completed query traces kept")
@@ -75,22 +79,41 @@ class FlightRecorder:
         if reason is not None:
             self._dump(trace, reason)
 
-    def dump(self, trace: QueryTrace, reason: str) -> None:
+    def dump(self, trace: QueryTrace, reason: str,
+             event_id: str | None = None) -> None:
         """Force-dump one trace (the latency-attribution regression
         sentinel's entry: an anomalous query auto-dumps its trace with
         reason ``LATENCY_REGRESSION`` even though its reply code and
-        duration look ordinary)."""
-        self._dump(trace, reason)
+        duration look ordinary). ``event_id`` names the cluster-journal
+        event that triggered the dump (obs/events.py) — SLO burns pass
+        their ``slo.burn`` event so the dump and the journal cross-link."""
+        self._dump(trace, reason, event_id=event_id)
 
-    def _dump(self, trace: QueryTrace, reason: str) -> None:
+    def _dump(self, trace: QueryTrace, reason: str,
+              event_id: str | None = None) -> None:
+        if event_id is None:
+            # no upstream trigger: journal the dump itself so the
+            # timeline still carries one correlated entry per dump
+            from wukong_tpu.obs.events import emit_event
+
+            event_id = emit_event(
+                "trace.dump", tenant=getattr(trace, "tenant", None),
+                qid=getattr(trace, "qid", None), reason=reason,
+                trace=trace.trace_id)
         with self._lock:
             self.dumps.append((reason, trace))
+            self.dump_meta.append({
+                "reason": reason, "trace_id": trace.trace_id,
+                "tenant": getattr(trace, "tenant", "default"),
+                "qid": getattr(trace, "qid", None),
+                "event_id": event_id})
         self._m_dumped.labels(reason=reason).inc()
         # the tenant rides the log line and the JSON (via to_dict) so an
         # anomaly dump is attributable without replaying the trace
         log_warn(f"flight recorder: trace {trace.trace_id} "
                  f"(tenant {getattr(trace, 'tenant', 'default')}) dumped "
-                 f"({reason}, {trace.dur_us:,}us, {len(trace.spans)} spans)")
+                 f"({reason}, {trace.dur_us:,}us, {len(trace.spans)} spans"
+                 + (f", event {event_id}" if event_id else "") + ")")
         dump_dir = Global.trace_dump_dir or os.environ.get("WUKONG_TRACE_DIR")
         if dump_dir:
             try:
@@ -98,10 +121,34 @@ class FlightRecorder:
                 path = os.path.join(dump_dir,
                                     f"trace_{trace.trace_id}.json")
                 with open(path, "w") as f:
-                    json.dump({"reason": reason, **trace.to_dict()}, f,
+                    json.dump({"reason": reason,
+                               **({"event_id": event_id} if event_id
+                                  else {}),
+                               **trace.to_dict()}, f,
                               indent=1, sort_keys=True)
+                self._prune_dump_dir(dump_dir)
             except OSError as e:  # a full disk must not fail the query path
                 log_warn(f"flight recorder: dump write failed: {e}")
+
+    @staticmethod
+    def _prune_dump_dir(dump_dir: str) -> None:
+        """Dump-dir retention (``trace_dump_max``): auto-dump storms used
+        to accumulate trace files without bound — keep the newest N,
+        evict the oldest by mtime. 0 disables (the legacy behavior)."""
+        cap = int(Global.trace_dump_max)
+        if cap <= 0:
+            return
+        try:
+            names = [n for n in os.listdir(dump_dir)
+                     if n.startswith("trace_") and n.endswith(".json")]
+            if len(names) <= cap:
+                return
+            paths = sorted((os.path.join(dump_dir, n) for n in names),
+                           key=lambda p: (os.path.getmtime(p), p))
+            for p in paths[:len(paths) - cap]:
+                os.remove(p)
+        except OSError as e:  # racing evictors / vanished files are fine
+            log_warn(f"flight recorder: dump-dir prune failed: {e}")
 
     # ------------------------------------------------------------------
     def last(self, n: int | None = None) -> list[QueryTrace]:
@@ -122,6 +169,7 @@ class FlightRecorder:
         with self._lock:
             self._ring.clear()
             self.dumps.clear()
+            self.dump_meta.clear()
 
 
 _recorder = FlightRecorder()

@@ -281,12 +281,21 @@ class SLOTracker:
         if verdict is not None:
             for w in verdict["windows"]:
                 _M_ALERTS.labels(tenant=tenant, window=w).inc()
+            # the burn lands in the cluster-event journal FIRST so the
+            # flight-recorder dump can reference its triggering event id
+            from wukong_tpu.obs.events import emit_event
+
+            eid = emit_event("slo.burn", tenant=tenant,
+                             fast_burn=verdict["fast_burn"],
+                             slow_burn=verdict["slow_burn"])
+            verdict["event_id"] = eid
             if trace is not None:
-                get_recorder().dump(trace, "SLO_BURN")
+                get_recorder().dump(trace, "SLO_BURN", event_id=eid)
             log_warn(
                 f"SLO burn: tenant {tenant} fast={verdict['fast_burn']:.1f}x"
                 f" slow={verdict['slow_burn']:.1f}x (budget "
-                f"{spec.budget:.4f}); "
+                f"{spec.budget:.4f}"
+                + (f", event {eid}" if eid else "") + "); "
                 + ("trace dumped" if trace is not None
                    else "no trace on this reply (enable_tracing for dumps)"))
         return verdict

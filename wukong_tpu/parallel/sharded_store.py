@@ -85,6 +85,15 @@ class ShardedDeviceStore:
         # shard -> [(host, GStore)]
         self.replicas: dict[int, list] = {}  # lock-free: whole-dict replacement in refresh_replicas; readers iterate a snapshot reference
         self.failover_shards: set[int] = set()  # lock-free: atomic set ops, same contract as degraded_shards
+        # journal-edge dedup for shard.failover/shard.degraded events:
+        # dict.setdefault is the atomic test-and-set a plain `in` check
+        # is not (two engine threads racing the first replica fetch must
+        # not double-journal one outage episode); keys are
+        # ("failover", shard, host) — per serving replica, so a
+        # mid-episode hop to the next replica is its own edge — and
+        # ("degraded", shard), swept by _rearm_events on recovery so the
+        # NEXT episode re-emits
+        self._event_noted: dict = {}  # lock-free: atomic dict setdefault/pop
         if self.replication_factor > 1:
             self.refresh_replicas()
 
@@ -133,8 +142,14 @@ class ShardedDeviceStore:
         self.breaker.record_success(int(i))  # promote: close the breaker
         self.degraded_shards.discard(int(i))
         self.failover_shards.discard(int(i))
+        self._rearm_events(int(i))
         self.invalidate_stagings()
         trace_event("shard.rebuild", shard=int(i), source=source)
+        from wukong_tpu.obs.events import emit_event
+        from wukong_tpu.obs.placement import get_lineage
+
+        emit_event("shard.rebuild", shard=int(i), source=source)
+        get_lineage().note_heal(int(i), source=source)
         get_registry().counter(
             "wukong_recovery_rebuilds_total",
             "Failed shards rebuilt and promoted",
@@ -162,6 +177,11 @@ class ShardedDeviceStore:
             # (failover_shards persists — it tracks the primary's health for
             # the recovery manager, not this staging's completeness)
             self.degraded_shards.clear()
+            # list() first: setdefault from concurrent fetch threads would
+            # otherwise race this iteration into a RuntimeError
+            for k in list(self._event_noted):
+                if k[0] == "degraded":
+                    self._event_noted.pop(k, None)
             return True
         return False
 
@@ -232,8 +252,18 @@ class ShardedDeviceStore:
             self._mark_degraded(i)
             maybe_charge(i, "degraded", None, get_usec() - t0)
             return None, False
+        was_down = i in self.degraded_shards or i in self.failover_shards
         self.degraded_shards.discard(i)
         self.failover_shards.discard(i)
+        # recovered: re-arm THIS shard's journal edges for the next
+        # episode. Gated on the shard actually having been down — while
+        # some other shard's episode holds claims, healthy shards' fetches
+        # must stay a set-membership test, not a per-fetch dict scan. A
+        # claim minted between the was_down read and the discard is swept
+        # by the next successful fetch (the claimant adds to the set
+        # right after claiming), so no edge is lost, only deferred.
+        if was_down and self._event_noted:
+            self._rearm_events(i)
         maybe_charge(i, "primary", out, get_usec() - t0)
         return out, True
 
@@ -264,9 +294,28 @@ class ShardedDeviceStore:
                 log_warn(f"replica {i}->{host} unavailable during {what} "
                          f"({e!r:.80}); trying the next replica")
                 continue
+            # journal the failover on the state EDGE only (the first
+            # fetch served by THIS replica, not every staging while the
+            # primary stays down — a dead primary under load would churn
+            # the bounded ring past the very timeline it preserves);
+            # setdefault-with-sentinel is the atomic claim. The claim is
+            # per (shard, host): a mid-episode hop to the next replica is
+            # its own edge — without it the timeline (and the lineage's
+            # failover_host) would keep naming the dead first replica
+            tok = object()
+            first = self._event_noted.setdefault(("failover", i, host),
+                                                 tok) is tok
             self.failover_shards.add(i)
             self.degraded_shards.discard(i)
+            self._event_noted.pop(("degraded", i), None)
             trace_event("shard.failover", shard=i, replica=host)
+            if first:
+                from wukong_tpu.obs.events import emit_event
+                from wukong_tpu.obs.placement import get_lineage
+
+                emit_event("shard.failover", shard=i, replica=host,
+                           what=what)
+                get_lineage().note_failover(i, host)
             get_registry().counter(
                 "wukong_failover_total",
                 "Shard fetches served by a replica after a primary failure",
@@ -274,10 +323,25 @@ class ShardedDeviceStore:
             return (out,)
         return None
 
+    def _rearm_events(self, i: int) -> None:
+        """Drop every journal-edge claim for shard ``i`` (failover claims
+        are per (shard, host), degraded per shard) so the next outage
+        episode journals afresh. list() first: concurrent fetch-thread
+        setdefault would race a live iteration into RuntimeError."""
+        for k in list(self._event_noted):
+            if k[1] == i:
+                self._event_noted.pop(k, None)
+
     def _mark_degraded(self, i: int) -> None:
+        from wukong_tpu.obs.events import emit_event
         from wukong_tpu.obs.metrics import get_registry
 
+        # journal on the state edge only (see _fetch_failover)
+        tok = object()
+        first = self._event_noted.setdefault(("degraded", i), tok) is tok
         self.degraded_shards.add(i)
+        if first:
+            emit_event("shard.degraded", shard=i)
         get_registry().counter(
             "wukong_shard_fetch_degraded_total",
             "Shard fetches that substituted empty data",

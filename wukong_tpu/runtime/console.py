@@ -46,6 +46,18 @@ top [-k <n>] [-j]            hot shards / templates / lanes (like top(1);
 slo [-k <n>] [-j]            per-tenant SLO compliance / error budgets /
                              burn rates + the overload signal bus (also
                              served at GET /slo on the metrics port)
+history [-k <n>] [-w <sec>] [-j]
+                             metrics trend windows from the time-series
+                             ring: counter rates, histogram percentiles,
+                             gauges (also GET /history)
+events [-k <n>] [-s <shard>] [-K <kind>] [-j]
+                             cluster event journal: breaker trips,
+                             failovers, heals, WAL/checkpoint lifecycle,
+                             SLO burns (also GET /events)
+plan [-j] [-n]               observe-only placement advisor: run one
+                             sweep and print the MigrationPlan + shard
+                             lineage (-n skips the fresh sweep; also
+                             GET /plan)
 metrics [-j]                 dump the metrics registry (Prometheus text, -j JSON)
 checkpoint                   write one atomic checkpoint (partitions + stream
                              state) to checkpoint_dir; truncates covered WAL
@@ -105,6 +117,12 @@ class Console:
                 self._top(rest)
             elif cmd == "slo":
                 self._slo(rest)
+            elif cmd == "history":
+                self._history(rest)
+            elif cmd == "events":
+                self._events(rest)
+            elif cmd == "plan":
+                self._plan_verb(rest)
             elif cmd == "metrics":
                 self._metrics(rest)
             elif cmd == "checkpoint":
@@ -125,10 +143,26 @@ class Console:
             print(Global.dump())
         elif rest[0] == "-l":
             load_config(rest[1])
+            self._apply_observatory_knobs()
         elif rest[0] == "-s":
             reload_config(" ".join(rest[1:]).replace("=", " "))
+            self._apply_observatory_knobs()
         else:
             log_error("usage: config <-v | -l <file> | -s <key value>>")
+
+    def _apply_observatory_knobs(self) -> None:
+        """The observatory knobs are runtime-mutable in BOTH directions:
+        the sampler/advisor threads check their knob per tick (on->off),
+        but a flip from off to on after boot needs the idempotent
+        starters re-invoked — without this, `config -s enable_tsdb true`
+        would silently never sample until a restart."""
+        from wukong_tpu.obs.placement import maybe_start_advisor
+        from wukong_tpu.obs.tsdb import maybe_start_tsdb
+
+        maybe_start_tsdb()
+        sstore = getattr(self.proxy.dist, "sstore", None) \
+            if self.proxy.dist is not None else None
+        maybe_start_advisor(sstore)
 
     def _sparql(self, rest) -> None:
         ap = argparse.ArgumentParser(prog="sparql")
@@ -293,8 +327,12 @@ class Console:
                         help="rows per section (default: the top_k knob)")
         ap.add_argument("-j", action="store_true", help="JSON output")
         ns = ap.parse_args(rest)
-        text, js = render_top(ns.k)
-        if ns.j:
+        self._print_report(ns.j, *render_top(ns.k))
+
+    @staticmethod
+    def _print_report(json_out: bool, text: str, js: dict) -> None:
+        """The shared (text, JSON) epilogue of every report verb."""
+        if json_out:
             import json
 
             print(json.dumps(js, indent=1, sort_keys=True, default=str))
@@ -311,13 +349,53 @@ class Console:
                         help="tenant rows shown (default: the top_k knob)")
         ap.add_argument("-j", action="store_true", help="JSON output")
         ns = ap.parse_args(rest)
-        text, js = render_slo(ns.k)
-        if ns.j:
-            import json
+        self._print_report(ns.j, *render_slo(ns.k))
 
-            print(json.dumps(js, indent=1, sort_keys=True, default=str))
-        else:
-            print(text, end="")
+    def _history(self, rest) -> None:
+        """history: metrics trend windows from the time-series ring
+        (the /history endpoint's body)."""
+        from wukong_tpu.obs.tsdb import render_history
+
+        ap = argparse.ArgumentParser(prog="history")
+        ap.add_argument("-k", type=int, default=None,
+                        help="rows per section (default: the top_k knob)")
+        ap.add_argument("-w", type=float, default=None,
+                        help="trend window seconds (default: retention)")
+        ap.add_argument("-j", action="store_true", help="JSON output")
+        ns = ap.parse_args(rest)
+        self._print_report(ns.j, *render_history(ns.k, ns.w))
+
+    def _events(self, rest) -> None:
+        """events: the cluster event journal (the /events body)."""
+        from wukong_tpu.obs.events import render_events
+
+        ap = argparse.ArgumentParser(prog="events")
+        ap.add_argument("-k", type=int, default=None,
+                        help="events shown (default: 4x the top_k knob)")
+        ap.add_argument("-s", type=int, default=None, metavar="shard",
+                        help="only events correlated to this shard")
+        ap.add_argument("-K", default=None, metavar="kind",
+                        help="only events of this kind")
+        ap.add_argument("-j", action="store_true", help="JSON output")
+        ns = ap.parse_args(rest)
+        self._print_report(ns.j, *render_events(ns.k, shard=ns.s,
+                                                kind=ns.K))
+
+    def _plan_verb(self, rest) -> None:
+        """plan: one observe-only placement-advisor sweep + the last
+        MigrationPlan and shard lineage (the /plan body)."""
+        from wukong_tpu.obs.placement import get_advisor, render_plan
+
+        ap = argparse.ArgumentParser(prog="plan")
+        ap.add_argument("-j", action="store_true", help="JSON output")
+        ap.add_argument("-n", action="store_true",
+                        help="no fresh sweep: print the last plan only")
+        ns = ap.parse_args(rest)
+        sstore = getattr(self.proxy.dist, "sstore", None) \
+            if self.proxy.dist is not None else None
+        if sstore is not None:
+            get_advisor().attach_store(sstore)
+        self._print_report(ns.j, *render_plan(advise=not ns.n))
 
     def _recover(self, rest) -> None:
         """recover: boot-style checkpoint+WAL restore. recover -d <shard>:

@@ -537,10 +537,18 @@ class Emulator:
         way live stagings do). Proves the telemetry the elastic-migration
         tentpole consumes: the heat report must rank the hot shard first,
         and the per-shard load-rate CDFs must separate hot from cold.
-        Returns {hot, ranked, separation, report} — ``separation`` is the
-        hot shard's p50 access rate over the hottest cold shard's.
+        The scenario then runs the observe-only PlacementAdvisor over the
+        tsdb trend window it just produced (ROADMAP item 3's acceptance
+        fixture): the emitted MigrationPlan must name the seeded hot
+        shard as top donor, and the store must be bit-untouched (verified
+        by per-shard store-version equality). Returns {hot, ranked,
+        separation, report, plan, plan_donor_is_hot, store_untouched} —
+        ``separation`` is the hot shard's p50 access rate over the
+        hottest cold shard's.
         """
         from wukong_tpu.obs.heat import get_heat
+        from wukong_tpu.obs.placement import get_advisor
+        from wukong_tpu.obs.tsdb import get_tsdb
 
         sstore = sstore if sstore is not None else getattr(
             self.proxy.dist, "sstore", None)
@@ -550,6 +558,9 @@ class Emulator:
                               "(--dist)")
         heat = get_heat()
         heat.reset()  # the scenario's ranking starts from a clean slate
+        tsdb = get_tsdb()
+        tsdb.reset()  # the advisor's trend window starts clean too
+        tsdb.sample_once()  # trend-window start marker
         rng = np.random.default_rng(seed)
         D = sstore.D
         hot = int(rng.integers(0, D))
@@ -571,6 +582,7 @@ class Emulator:
         draws = rng.choice(D, size=int(n_ops), p=w)
         for r in draws:
             sstore._fetch_shard(order[int(r)], read_partition, "hotspot")
+        tsdb.sample_once()  # trend-window end marker
         report = self.monitor.heat_report(k=D)
         ranked = [r["shard"] for r in report["ranked"]]
         hot_rate = report["shards"][hot]["load_rate_cdf"].get(0.5, 0.0)
@@ -578,12 +590,37 @@ class Emulator:
                       for s, d in report["shards"].items() if s != hot]
         separation = (hot_rate / max(cold_rates)
                       if cold_rates and max(cold_rates) > 0 else float("inf"))
+        # the observe-only proof: identity + version + content CRC per
+        # shard, before vs after advising. Version alone is vacuous on a
+        # freshly built world (0 until the first dynamic insert), and
+        # identity alone misses in-place array writes — the digest walks
+        # every persisted array, so neither a swapped stores[] entry nor
+        # a raw write can leave the tuple unchanged
+        from wukong_tpu.store.persist import gstore_digest
+
+        def _fingerprint():
+            return [(id(g), int(getattr(g, "version", 0)), gstore_digest(g))
+                    for g in sstore.stores]
+
+        fp_before = _fingerprint()
+        advisor = get_advisor()  # the singleton: /plan + Monitor surface it
+        advisor.attach_store(sstore)
+        plan = advisor.advise_once()
+        store_untouched = _fingerprint() == fp_before
+        donor_is_hot = plan is not None and plan.donor_shard == hot
         log_info(f"hotspot: shard {hot} drew "
                  f"{report['shards'][hot]['share']:.0%} of {n_ops} fetches; "
                  f"ranked={ranked[:4]}..., load-rate separation "
-                 f"{separation:.1f}x")
+                 f"{separation:.1f}x; advisor "
+                 + (f"plan donor={plan.donor_shard} (hot={donor_is_hot}, "
+                    f"~{plan.predicted_move_bytes / 2**20:.1f} MiB, "
+                    f"store untouched={store_untouched})"
+                    if plan is not None else "emitted no plan"))
         return {"hot": hot, "ranked": ranked,
-                "separation": separation, "report": report}
+                "separation": separation, "report": report,
+                "plan": plan.to_dict() if plan is not None else None,
+                "plan_donor_is_hot": donor_is_hot,
+                "store_untouched": bool(store_untouched)}
 
     # ------------------------------------------------------------------
     # multi-tenant SLO scenario (ROADMAP item 4 acceptance fixture)

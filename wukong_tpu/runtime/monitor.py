@@ -164,6 +164,10 @@ class Monitor:
                 log_info(line)
             for line in self.slo_lines(k=3):
                 log_info(line)
+            for line in self.events_lines(k=4):
+                log_info(line)
+            for line in self.placement_lines():
+                log_info(line)
             self._last_print = now
             self._last_cnt = self.cnt
 
@@ -299,6 +303,43 @@ class Monitor:
                 + f" burn {burn.get('fast', 0):.1f}/{burn.get('slow', 0):.1f}"
                 + (f" alerts {r['alerts']}" if r["alerts"] else ""))
         return ["SLO[" + "  ".join(parts) + "]"]
+
+    def events_lines(self, k: int = 4) -> list[str]:
+        """Rolling-report line for the cluster event journal
+        (obs/events.py): total journaled events + the k most frequent
+        kinds and the newest event — quiet while nothing happened."""
+        from wukong_tpu.obs.events import get_journal
+
+        j = get_journal()
+        counts = j.counts()
+        if not counts:
+            return []
+        top = sorted(counts.items(), key=lambda kv: (-kv[1], kv[0]))[:k]
+        newest = j.last(1)
+        tail = ""
+        if newest:
+            e = newest[0]
+            tail = (f"; last {e.event_id} {e.kind}"
+                    + (f" shard={e.shard}" if e.shard is not None else ""))
+        return ["Events[" + "  ".join(f"{kd}:{n}" for kd, n in top)
+                + f"] ({sum(counts.values())} total{tail})"]
+
+    def placement_lines(self) -> list[str]:
+        """Rolling-report line for the observe-only placement advisor
+        (obs/placement.py): the last MigrationPlan, or nothing while no
+        plan has been emitted (balanced clusters stay quiet)."""
+        from wukong_tpu.obs.placement import get_advisor
+
+        st = get_advisor().status()
+        p = st["plan"]
+        if p is None:
+            return []
+        return [f"Placement[plan {p['plan_id']}: donor shard "
+                f"{p['donor_shard']} -> host {p['recipient_host']}, "
+                f"{p['predicted_move_bytes'] / 2**20:.1f} MiB "
+                f"({p['bytes_source']}), imbalance "
+                f"{p['imbalance_before']:.2f} -> "
+                f"{p['imbalance_after']:.2f}]"]
 
     def heat_lines(self, k: int = 3) -> list[str]:
         """Rolling-report lines: the top-k hot shards, only when any fetch

@@ -114,6 +114,38 @@ class Proxy:
             self.recovery().start()
         # metrics scrape endpoint (metrics_port knob; no-op when 0/off)
         maybe_start_metrics_http()
+        # the placement observatory: the metrics time-series sampler
+        # (enable_tsdb; trend windows for /history and the advisor) and —
+        # with a sharded store — the observe-only placement advisor
+        # (placement_interval_s > 0 runs its loop; 0 = on-demand /plan)
+        from wukong_tpu.obs.placement import maybe_start_advisor
+        from wukong_tpu.obs.tsdb import maybe_start_tsdb
+
+        maybe_start_tsdb()
+        sstore = getattr(dist_engine, "sstore", None)
+        if sstore is not None:
+            maybe_start_advisor(sstore)
+            # /healthz readiness probe: degraded or failover shards mean
+            # the process serves, but not at full strength. The probe
+            # holds the store through a weakref: the registry is
+            # process-global, so a strong capture would keep a retired
+            # world's degraded set driving readiness (503 under
+            # health_ready_503) long after the store that owned it died
+            import weakref
+
+            from wukong_tpu.obs.httpd import register_health_source
+
+            ss_ref = weakref.ref(sstore)
+
+            def _shard_probe():
+                ss = ss_ref()
+                if ss is None or not (ss.degraded_shards
+                                      or ss.failover_shards):
+                    return None
+                return {"degraded": sorted(ss.degraded_shards),
+                        "failover": sorted(ss.failover_shards)}
+
+            register_health_source("shards", _shard_probe)
         # surface the sharded store's per-shard breaker in the rolling
         # throughput report (resilience observability, PR 1 follow-up)
         breaker = getattr(getattr(dist_engine, "sstore", None), "breaker", None)

@@ -44,6 +44,7 @@ import zlib
 
 from wukong_tpu.analysis.lockdep import make_lock
 from wukong_tpu.config import Global
+from wukong_tpu.obs.events import emit_event
 from wukong_tpu.obs.metrics import get_registry
 from wukong_tpu.obs.trace import trace_event
 from wukong_tpu.store.persist import (
@@ -186,10 +187,24 @@ class RecoveryManager:
             wal_seq = (wal.next_seq - 1) if wal is not None else -1
             t0 = time.monotonic()
             parts = []
+            ckpt_bytes = 0
             for idx, g in enumerate(self.stores):
-                save_gstore(g, checkpoint_part_path(tmp, idx))
+                ppath = checkpoint_part_path(tmp, idx)
+                save_gstore(g, ppath)
+                nbytes = os.path.getsize(ppath)
+                ckpt_bytes += nbytes
                 parts.append({"sid": int(g.sid),
-                              "num_workers": int(g.num_workers)})
+                              "num_workers": int(g.num_workers),
+                              "bytes": int(nbytes)})
+                # the placement ledger's predicted-move-bytes source:
+                # each DISTRIBUTED shard's measured on-disk size (the
+                # host partition spans every shard — recording it under
+                # its sid would overwrite shard 0's real size)
+                if (self.sstore is None
+                        or int(g.num_workers) == self.sstore.D):
+                    from wukong_tpu.obs.placement import get_lineage
+
+                    get_lineage().note_checkpoint(int(g.sid), nbytes)
             man = {"format": list(MANIFEST_VERSION), "wal_seq": int(wal_seq),
                    "parts": parts, "stream": False, "epoch": 0}
             if self.stream is not None:
@@ -209,6 +224,8 @@ class RecoveryManager:
             _M_CKPTS.inc()
             trace_event("checkpoint.write", path=final, wal_seq=wal_seq,
                         parts=len(parts))
+            emit_event("checkpoint.write", path=final, wal_seq=wal_seq,
+                       parts=len(parts), bytes=int(ckpt_bytes))
             log_info(f"checkpoint {final} written in "
                      f"{time.monotonic() - t0:.2f}s "
                      f"({len(parts)} part(s), wal_seq={wal_seq})")
@@ -344,6 +361,8 @@ class RecoveryManager:
                 trace.end_span(sp, parts=len(man["parts"]),
                                wal_seq=after_seq)
             _M_RESTORES.inc()
+            emit_event("recovery.restore", path=path,
+                       parts=len(man["parts"]), wal_seq=after_seq)
         # the stream context's insert fan-out list may reference replicas
         # that refresh_replicas just replaced — rebind before replay
         if self.stream is not None:
@@ -406,6 +425,9 @@ class RecoveryManager:
                 _M_REPLAYED.labels(kind=kind).inc()
         if sp is not None:
             trace.end_span(sp, **stats["replayed"])
+        if sum(stats["replayed"].values()):
+            emit_event("recovery.replay", after_seq=after_seq,
+                       **stats["replayed"])
 
     # ------------------------------------------------------------------
     # runtime healing
@@ -479,6 +501,7 @@ class RecoveryManager:
             return False
         if ss.rebuild_shard(i, source="replica"):
             log_info(f"shard {i} rebuilt from replica and promoted")
+            emit_event("shard.heal", shard=int(i), source="replica")
             self._after_rebuild()
             return True
         found = self.newest_checkpoint()
@@ -509,6 +532,7 @@ class RecoveryManager:
                                check_ids=False)
         ss.rebuild_shard(i, store=g_new, source="checkpoint")
         log_info(f"shard {i} rebuilt from {path} + WAL tail and promoted")
+        emit_event("shard.heal", shard=int(i), source="checkpoint")
         self._after_rebuild()
         return True
 

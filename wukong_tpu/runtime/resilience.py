@@ -55,6 +55,19 @@ _M_BREAKER_TRIPS = get_registry().counter(
 declare_leaf("breaker.state")
 
 
+def _emit_breaker_event(kind: str, key) -> None:
+    """Cluster-event journal hook for breaker transitions (obs/events.py):
+    per-shard breaker keys carry the shard as a correlation key — a
+    (shard, host) replica key correlates on the shard too. Fires OUTSIDE
+    the breaker lock like every other hook here."""
+    from wukong_tpu.obs.events import emit_event
+
+    shard = key if isinstance(key, int) else (
+        key[0] if isinstance(key, tuple) and key
+        and isinstance(key[0], int) else None)
+    emit_event(kind, shard=shard, key=str(key))
+
+
 class Deadline:
     """Wall-clock deadline + intermediate-row budget for one query."""
 
@@ -279,6 +292,7 @@ class CircuitBreaker:
             self._st[key] = [0, None, False]
         if was_open:  # a half-open trial just recovered the key
             trace_event("breaker.close", key=str(key))
+            _emit_breaker_event("breaker.close", key)
 
     def record_abort(self, key) -> None:
         """The admitted call never dispatched (e.g. deadline expiry between
@@ -306,6 +320,7 @@ class CircuitBreaker:
         if tripped:  # outside the lock: hooks must not hold breaker state
             trace_event("breaker.trip", key=str(key))
             _M_BREAKER_TRIPS.labels(key=str(key)).inc()
+            _emit_breaker_event("breaker.trip", key)
 
     def tripped(self, key) -> bool:
         return self.state(key) != "closed"

@@ -101,6 +101,13 @@ get_registry().gauge(
     "Busy fraction of live pool engines").set_function(_pool_utilization)
 
 
+def dead_engine_count() -> int:
+    """Engines declared dead (respawn budget exhausted) across every live
+    pool — a /healthz readiness input (obs/httpd.py health_report)."""
+    return sum(1 for p in list(_POOLS) for t in range(p.n)
+               if p._dead[t])  # unguarded: report-only snapshot, like health()
+
+
 class EnginePool:
     # engine-thread crashes (outside the per-query try) respawn up to this
     # many times per tid; past it the engine is declared dead, its queue is

@@ -41,7 +41,11 @@ def _crc(arr: np.ndarray) -> int:
     return zlib.crc32(np.ascontiguousarray(arr).tobytes())
 
 
-def save_gstore(g: GStore, path: str) -> None:
+def _collect_arrays(g: GStore) -> tuple[dict, dict]:
+    """(meta, arrays): the canonical array walk of a partition — every
+    array save_gstore persists, in a stable order. Shared with
+    gstore_digest so the checkpoint surface and the bit-identity proof
+    can never drift."""
     arrays: dict[str, np.ndarray] = {}
     meta = {"format": FORMAT_NAME, "version": list(FORMAT_VERSION),
             "store_version": int(getattr(g, "version", 0)),
@@ -68,6 +72,23 @@ def save_gstore(g: GStore, path: str) -> None:
     arrays["v_set"] = g.v_set
     arrays["t_set"] = g.t_set
     arrays["p_set"] = g.p_set
+    return meta, arrays
+
+
+def gstore_digest(g: GStore) -> int:
+    """Running CRC over every persisted array of a partition. The
+    observe-only drills compare this before/after advising: unlike the
+    store version (0 until the first dynamic insert), a raw in-place
+    array write cannot leave it unchanged."""
+    crc = 0
+    _, arrays = _collect_arrays(g)
+    for name in sorted(arrays):
+        crc = zlib.crc32(np.ascontiguousarray(arrays[name]).tobytes(), crc)
+    return crc
+
+
+def save_gstore(g: GStore, path: str) -> None:
+    meta, arrays = _collect_arrays(g)
     meta["checksums"] = {name: _crc(a) for name, a in arrays.items()}
     arrays["_meta"] = np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8)
     np.savez(path, **arrays)

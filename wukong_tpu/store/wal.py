@@ -65,6 +65,16 @@ SYNC_POLICIES = ("none", "interval", "always")
 declare_leaf("wal.segment")
 
 
+def _emit_wal_event(kind: str, **attrs) -> None:
+    """Cluster-event journal hook (obs/events.py), lazily imported so the
+    WAL's import graph stays flat. MUST be called with the segment lock
+    released: the journal's ring lock is itself a lockdep leaf, and
+    acquiring any lock under wal.segment is an inversion."""
+    from wukong_tpu.obs.events import emit_event
+
+    emit_event(kind, **attrs)
+
+
 @dataclass
 class WalRecord:
     seq: int
@@ -155,9 +165,12 @@ class WriteAheadLog:
         path = segs[-1][1]
         last_seq, valid_end = self._scan_segment_tail(path)
         if valid_end < os.path.getsize(path):
+            dropped = os.path.getsize(path) - valid_end
             log_warn(f"WAL torn tail at {path}:{valid_end}: truncating "
-                     f"{os.path.getsize(path) - valid_end} bytes of the "
+                     f"{dropped} bytes of the "
                      "unacknowledged record before resuming appends")
+            _emit_wal_event("wal.torn_tail", path=path, offset=valid_end,
+                            dropped_bytes=int(dropped), where="open")
             with open(path, "r+b") as f:
                 f.truncate(valid_end)
         return (last_seq + 1) if last_seq is not None else segs[-1][0]
@@ -231,12 +244,20 @@ class WriteAheadLog:
         from wukong_tpu.runtime import faults
 
         faults.site("wal.append")
+        rotated = None
         with self._lock:
             seq = self.next_seq
             body = pickle.dumps((seq, kind, payload),
                                 protocol=pickle.HIGHEST_PROTOCOL)
             if self._fh is None or self._fh_bytes >= self.segment_bytes:
+                # a size rotation (an open segment hit wal_segment_mb) is
+                # a journal-worthy lifecycle event; the first-ever open is
+                # not. Emission waits for the lock release below —
+                # events.ring is a leaf and so is wal.segment.
+                rotating = self._fh is not None
                 self._open_segment(seq)
+                if rotating:
+                    rotated = self._fh.name
             self._fh.write(_HDR.pack(len(body), zlib.crc32(body)))
             self._fh.write(body)
             self._fh.flush()
@@ -253,6 +274,8 @@ class WriteAheadLog:
             self.next_seq = seq + 1
         self._m_appends.labels(kind=kind).inc()
         self._m_bytes.inc(_HDR.size + len(body))
+        if rotated is not None:
+            _emit_wal_event("wal.rotate", path=rotated, first_seq=seq)
         return seq
 
     def close(self) -> None:
@@ -276,18 +299,24 @@ class WriteAheadLog:
             if off + _HDR.size > n:
                 log_warn(f"WAL torn tail at {path}:{off} (short header); "
                          "dropping the unacknowledged record")
+                _emit_wal_event("wal.torn_tail", path=path, offset=off,
+                                where="replay")
                 return
             blen, crc = _HDR.unpack_from(data, off)
             body = data[off + _HDR.size: off + _HDR.size + blen]
             if len(body) < blen:
                 log_warn(f"WAL torn tail at {path}:{off} (short body); "
                          "dropping the unacknowledged record")
+                _emit_wal_event("wal.torn_tail", path=path, offset=off,
+                                where="replay")
                 return
             if zlib.crc32(body) != crc:
                 if off + _HDR.size + blen >= n:
                     # final record: a torn in-place overwrite, same contract
                     log_warn(f"WAL torn tail at {path}:{off} (bad crc on "
                              "final record); dropping it")
+                    _emit_wal_event("wal.torn_tail", path=path, offset=off,
+                                    where="replay")
                     return
                 raise CheckpointCorrupt(
                     f"WAL crc mismatch mid-segment at offset {off}",
