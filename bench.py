@@ -911,6 +911,86 @@ def hotspot_main(device_ok: bool) -> None:
     }, "BENCH_HOTSPOT.json")
 
 
+def rebalance_main(device_ok: bool) -> None:
+    """`bench.py --rebalance`: the hot-spot drill flipped from
+    observe-only to EXECUTED (Emulator.run_rebalance — the elastic data
+    plane's acceptance drill). The Zipfian scenario produces the
+    advisor's MigrationPlan, the live shard-migration actuator
+    (runtime/migration.py) drives it through clone/catch-up/cutover/
+    retire with a byte-identical probe after every phase, then the SAME
+    skew replays against the post-move placement. Headline:
+    `rebalance_gain` — pre-move over post-move host load-rate imbalance
+    (>1 means the move paid for itself; the drill FAILS unless the
+    post-move imbalance lands under `placement_imbalance_x` and every
+    probe matched the pre-migration oracle). Artifact:
+    BENCH_REBALANCE.json with moved bytes + measured cutover pause."""
+    import numpy as np
+
+    from wukong_tpu.config import Global
+    from wukong_tpu.engine.cpu import CPUEngine
+    from wukong_tpu.loader.lubm import VirtualLubmStrings, generate_lubm
+    from wukong_tpu.parallel.sharded_store import ShardedDeviceStore
+    from wukong_tpu.runtime.emulator import Emulator
+    from wukong_tpu.runtime.proxy import Proxy
+    from wukong_tpu.store.gstore import build_partition
+
+    n_shards = 4
+    triples, _ = generate_lubm(1, seed=42)
+    g = build_partition(triples, 0, 1)
+    ss = VirtualLubmStrings(1, seed=42)
+    stores = [build_partition(triples, i, n_shards)
+              for i in range(n_shards)]
+
+    class _Mesh:
+        devices = np.empty(n_shards, dtype=object)
+
+    sstore = ShardedDeviceStore(stores, _Mesh(), replication_factor=1)
+    proxy = Proxy(g, ss, cpu_engine=CPUEngine(g, ss))
+    prev = Global.migration_enable
+    Global.migration_enable = True  # the drill IS the armed posture
+    try:
+        emu = Emulator(proxy)
+        rep = emu.run_rebalance(n_ops=1500, zipf_a=1.6, seed=7,
+                                sstore=sstore)
+    finally:
+        Global.migration_enable = prev
+    if not (rep["rebalanced"] and rep["queries_identical"]):
+        raise SystemExit(
+            f"rebalance drill FAILED: rebalanced={rep['rebalanced']} "
+            f"queries_identical={rep['queries_identical']} "
+            f"probes={rep['probes']}")
+    job = rep["job"]
+    _emit_final({
+        "metric": "LUBM-1 Zipfian rebalance drill: pre/post host "
+                  "load-rate imbalance ratio across one executed shard "
+                  "migration (clone/catch-up/cutover/retire, probes "
+                  "byte-identical throughout)",
+        "value": round(rep["rebalance_gain"], 2),
+        "unit": "x",
+        "rebalance_gain": round(rep["rebalance_gain"], 2),
+        "rebalanced": rep["rebalanced"],
+        "queries_identical": rep["queries_identical"],
+        "backend": "cpu",  # host-side fetch path; no device work
+        "detail": {
+            "hot": rep["hot"],
+            "plan": rep["plan"],
+            "job": job,
+            "probes": rep["probes"],
+            "imbalance_before": rep["imbalance_before"],
+            "imbalance_after": rep["imbalance_after"],
+            "decision_after": rep["decision_after"],
+            "moved_bytes": job["bytes_moved"],
+            "cutover_pause_us": job["cutover_pause_us"],
+            "wal_records_caught_up": job["replayed"],
+            "donor_rotated": job["rotated"],
+            "threshold": max(float(Global.placement_imbalance_x), 1.0),
+            "zipf_a": 1.6,
+            "n_ops": 1500,
+            "shards": n_shards,
+        },
+    }, "BENCH_REBALANCE.json")
+
+
 def cyclic_main(device_ok: bool) -> None:
     """`bench.py --cyclic`: the cyclic workload suite (triangle / diamond /
     4-clique synthetic worlds + the WatDiv-based cyclic query set), each
@@ -2240,6 +2320,9 @@ def main():
         return
     if "--hotspot" in sys.argv:
         hotspot_main(device_ok)
+        return
+    if "--rebalance" in sys.argv:
+        rebalance_main(device_ok)
         return
     if "--watdiv" in sys.argv:
         watdiv_main(device_ok)

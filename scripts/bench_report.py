@@ -62,6 +62,14 @@ def _headline(d: dict) -> dict | None:
     if isinstance(d.get("hotspot_separation"), (int, float)):
         return {"value": float(d["hotspot_separation"]), "unit": "x",
                 "metric": "hotspot_separation"}
+    # rebalance drill: pre/post host load-rate imbalance across one
+    # EXECUTED shard migration (BENCH_REBALANCE.json; unit "x" is
+    # direction-less and the drill self-gates — bench.py --rebalance
+    # exits non-zero unless post-move imbalance clears the threshold and
+    # every mid-migration probe was byte-identical)
+    if isinstance(d.get("rebalance_gain"), (int, float)):
+        return {"value": float(d["rebalance_gain"]), "unit": "x",
+                "metric": "rebalance_gain"}
     if isinstance(d.get("value"), (int, float)):
         return {"value": float(d["value"]), "unit": d.get("unit", ""),
                 "metric": str(d.get("metric", ""))[:160]}

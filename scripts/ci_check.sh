@@ -16,6 +16,12 @@ echo "== tier-1 pytest (-m 'not slow') =="
 JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider "$@"
 
+echo "== elastic rebalance drill (executed shard migration) =="
+# the hot-spot drill, armed: the actuator must move the advisor's donor
+# shard with byte-identical probes at every phase and land the post-move
+# host imbalance under placement_imbalance_x (exits non-zero otherwise)
+JAX_PLATFORMS=cpu python bench.py --rebalance
+
 echo "== bench trajectory check =="
 python scripts/bench_report.py --check
 

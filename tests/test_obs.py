@@ -354,6 +354,8 @@ def test_chaos_sharded_fetch_spans_in_dist_trace(world, monkeypatch):
     store.degraded_shards = set()
     store.failover_shards = set()
     store.replicas = {}
+    store.rotation = {}
+    store._rotation_rr = {}
     store._event_noted = {}
     faults.install(FaultPlan([FaultSpec("dist.shard_fetch", "transient",
                                         count=1)], seed=0))

@@ -191,6 +191,7 @@ def _load_builtin_plugins() -> None:
         drift,
         guarded,
         joingate,
+        migrategate,
         obs_gates,
         placegate,
         slogate,

@@ -67,6 +67,9 @@ WAL_ALLOWLIST = {
     # (boot) or onto a not-yet-promoted partition under the mutation lock
     ("runtime/recovery.py", "_replay_wal"),
     ("runtime/recovery.py", "_rebuild_shard_locked"),
+    # migration catch-up replays the durable tail onto the not-yet-serving
+    # recipient under the mutation lock + WAL suppression
+    ("runtime/migration.py", "_phase_catchup"),
 }
 
 

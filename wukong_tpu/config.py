@@ -211,6 +211,21 @@ class GlobalConfig:
     # so a load balancer drains it; liveness stays 200 either way when off
     health_ready_503: bool = False
 
+    # ---- elastic data plane: the live shard-migration actuator
+    # (runtime/migration.py; all mutable) ----
+    # execute the placement advisor's MigrationPlans (clone -> catch-up ->
+    # cutover -> retire). OFF by default: the advisor stays observe-only
+    # (the PR 11 posture) and both the `migrate` verb and the executor
+    # refuse to move shards. On + placement_interval_s > 0 runs the
+    # actuator loop: plans execute continuously against PLACEMENT_INPUTS.
+    migration_enable: bool = False
+    # cutover posture: on (default) demotes the donor copy to a
+    # read-rotation replica on its old host — reads split across
+    # donor+recipient, exactly the MigrationPlan's predicted-balance model
+    # (replica-read rotation, ROADMAP follow-up j). Off retires the donor
+    # copy outright (the recipient serves alone).
+    migration_rotate_reads: bool = True
+
     # ---- tenant-aware SLO plane (obs/slo.py; all mutable) ----
     # per-tenant accounting at the proxy reply point: tenant-labeled reply
     # counters/latency histograms, per-tenant in-flight + arrival-rate

@@ -48,6 +48,10 @@ EVENT_KINDS = (
     "shard.rebuild", "shard.heal", "checkpoint.write", "recovery.restore",
     "recovery.replay", "wal.rotate", "wal.torn_tail", "slo.burn",
     "latency.regression", "trace.dump",
+    # the shard-migration actuator's phase transitions
+    # (runtime/migration.py; correlate with -K shard.migrate)
+    "shard.migrate.start", "shard.migrate.catchup",
+    "shard.migrate.cutover", "shard.migrate.retire", "shard.migrate.abort",
 )
 
 # the journal lock guards a deque append and the JSONL file handle —
@@ -152,11 +156,14 @@ class EventJournal:
     def last(self, n: int | None = None, kind: str | None = None,
              shard: int | None = None) -> list[ClusterEvent]:
         """Newest-last view of the ring, optionally filtered by kind
-        and/or correlation shard."""
+        and/or correlation shard. The kind filter matches exactly OR as a
+        run of dotted segments — ``shard.migrate`` (or just ``migrate``)
+        selects every ``shard.migrate.*`` phase event as one timeline."""
         with self._lock:
             evs = list(self._ring)
         if kind is not None:
-            evs = [e for e in evs if e.kind == kind]
+            needle = f".{kind}."
+            evs = [e for e in evs if f".{e.kind}.".find(needle) >= 0]
         if shard is not None:
             evs = [e for e in evs if e.shard == int(shard)]
         return evs if n is None else evs[-n:]

@@ -43,8 +43,9 @@ PLACEMENT_INPUTS = {
     "ewma_us": "wukong_shard_heat_ewma_us",
 }
 
-#: fetch outcome kinds a charge may carry (sharded_store._fetch_shard_impl)
-FETCH_KINDS = ("primary", "failover", "degraded")
+#: fetch outcome kinds a charge may carry (sharded_store._fetch_shard_impl;
+#: "rotation" = a migrated shard's read served by its demoted donor copy)
+FETCH_KINDS = ("primary", "failover", "degraded", "rotation")
 
 EWMA_ALPHA = 0.2
 

@@ -90,13 +90,17 @@ class MigrationPlan:
 
 
 class _ShardRecord:
-    __slots__ = ("primary_host", "replica_hosts", "store_version",
-                 "last_failover_us", "failover_host", "last_heal_us",
-                 "heal_source", "checkpoint_bytes", "checkpoint_t_us")
+    __slots__ = ("primary_host", "replica_hosts", "rotation_hosts",
+                 "store_version", "last_failover_us", "failover_host",
+                 "last_heal_us", "heal_source", "checkpoint_bytes",
+                 "checkpoint_t_us")
 
     def __init__(self):
         self.primary_host = None
         self.replica_hosts: tuple = ()
+        # hosts whose demoted donor copies still serve rotated reads
+        # after a migration (runtime/migration.py; follow-up j)
+        self.rotation_hosts: tuple = ()
         self.store_version = 0
         self.last_failover_us = 0
         self.failover_host = None  # the replica host serving the shard
@@ -121,11 +125,13 @@ class ShardLineage:
 
     # -- producers ------------------------------------------------------
     def note_placement(self, shard: int, primary_host: int,
-                       replica_hosts=(), store_version: int = 0) -> None:
+                       replica_hosts=(), store_version: int = 0,
+                       rotation_hosts=()) -> None:
         with self._lock:
             r = self._rec(shard)
             r.primary_host = int(primary_host)
             r.replica_hosts = tuple(int(h) for h in replica_hosts)
+            r.rotation_hosts = tuple(int(h) for h in rotation_hosts)
             r.store_version = int(store_version)
 
     def note_failover(self, shard: int, replica_host: int) -> None:
@@ -150,17 +156,23 @@ class ShardLineage:
 
     # -- readers --------------------------------------------------------
     def observe_store(self, sstore) -> None:
-        """Fold a sharded store's CURRENT placement (primary = identity
-        host, replicas = successor hosts) and per-shard store versions
-        into the ledger — called before advising so the plan reads live
-        topology, not a stale note."""
+        """Fold a sharded store's CURRENT placement (the migration-aware
+        ``placement`` map when present, identity otherwise; replicas =
+        successor hosts; rotation = demoted donor copies still serving
+        reads) and per-shard store versions into the ledger — called
+        before advising so the plan reads live topology, not a stale
+        note."""
         if sstore is None:
             return
         replicas = dict(getattr(sstore, "replicas", {}) or {})
+        placement = dict(getattr(sstore, "placement", {}) or {})
+        rotation = dict(getattr(sstore, "rotation", {}) or {})
         for i, g in enumerate(sstore.stores):
             self.note_placement(
-                i, i, tuple(h for (h, _g) in replicas.get(i, ())),
-                getattr(g, "version", 0))
+                i, placement.get(i, i),
+                tuple(h for (h, _g) in replicas.get(i, ())),
+                getattr(g, "version", 0),
+                rotation_hosts=tuple(h for (h, _g) in rotation.get(i, ())))
 
     def checkpoint_bytes(self, shard: int) -> int:
         with self._lock:
@@ -176,17 +188,30 @@ class ShardLineage:
                 return None, ()
             return r.primary_host, r.replica_hosts
 
+    def serving_hosts_of(self, shard: int) -> tuple:
+        """Every host currently SERVING reads for the shard: the primary
+        plus any read-rotation copies. The advisor splits the shard's load
+        rate across exactly this set — imbalance must reflect who actually
+        answers the fetches."""
+        with self._lock:
+            r = self._shards.get(int(shard))
+            if r is None or r.primary_host is None:
+                return ()
+            return (r.primary_host, *r.rotation_hosts)
+
     def report(self) -> dict:
         with self._lock:
-            snap = {s: (r.primary_host, r.replica_hosts, r.store_version,
-                        r.last_failover_us, r.failover_host,
-                        r.last_heal_us, r.heal_source, r.checkpoint_bytes)
+            snap = {s: (r.primary_host, r.replica_hosts, r.rotation_hosts,
+                        r.store_version, r.last_failover_us,
+                        r.failover_host, r.last_heal_us, r.heal_source,
+                        r.checkpoint_bytes)
                     for s, r in self._shards.items()}
         return {s: {"primary_host": p, "replica_hosts": list(reps),
+                    "rotation_hosts": list(rots),
                     "store_version": v, "last_failover_us": fo,
                     "failover_host": fh, "last_heal_us": heal,
                     "heal_source": hs, "checkpoint_bytes": cb}
-                for s, (p, reps, v, fo, fh, heal, hs, cb)
+                for s, (p, reps, rots, v, fo, fh, heal, hs, cb)
                 in sorted(snap.items())}
 
     def reset(self) -> None:
@@ -300,13 +325,16 @@ class PlacementAdvisor:
         return max(vals) / mean if mean > 0 else 0.0
 
     @staticmethod
-    def _shard_hosts(rates: dict[int, float],
-                     lineage: "ShardLineage") -> dict[int, int]:
-        """shard -> the host serving its primary (identity fallback)."""
-        m: dict[int, int] = {}
+    def _serving_map(rates: dict[int, float],
+                     lineage: "ShardLineage") -> dict[int, tuple]:
+        """shard -> the hosts serving its reads (primary + rotation
+        copies; identity fallback). The load split the migration actuator
+        makes real (replica-read rotation) is scored the same way it is
+        served: a shard's rate divides evenly across this set."""
+        m: dict[int, tuple] = {}
         for s in rates:
-            p, _reps = lineage.hosts_of(s)
-            m[s] = p if p is not None else s
+            hs = lineage.serving_hosts_of(s)
+            m[s] = hs if hs else (s,)
         return m
 
     def _decide(self, rates: dict[int, float], win: float,
@@ -314,37 +342,42 @@ class PlacementAdvisor:
         """(decision label, current imbalance, plan | None). Caller holds
         no locks. Imbalance is scored over HOST loads everywhere
         (trigger, before, after): with identity placement that equals the
-        per-shard view, and once a control plane co-locates two shards on
-        one host the overloaded HOST is what must read as imbalanced."""
-        shard_host = self._shard_hosts(rates, lineage)
+        per-shard view, and once the control plane co-locates or rotates
+        shards the overloaded HOST is what must read as imbalanced."""
+        serving = self._serving_map(rates, lineage)
         hosts: dict[int, float] = {}
         for s, r in rates.items():
-            hosts[shard_host[s]] = hosts.get(shard_host[s], 0.0) + r
+            for h in serving[s]:
+                hosts[h] = hosts.get(h, 0.0) + r / len(serving[s])
         imb = self._imbalance(hosts)
         if len(rates) < 2 or sum(rates.values()) <= 0:
             return "no_data", imb, None
         threshold = max(float(Global.placement_imbalance_x), 1.0)
         if imb < threshold:
             return "balanced", imb, None
-        # donor = the hottest shard ON the overloaded host — the global
-        # max-rate shard can sit on a healthy host once placement is no
-        # longer identity, and moving it would not relieve the trigger
+        # donor = the hottest shard SERVED BY the overloaded host — the
+        # global max-rate shard can sit on a healthy host once placement
+        # is no longer identity, and moving it would not relieve the
+        # trigger
         hot_host = max(sorted(hosts), key=lambda h: hosts[h])
-        on_hot = [s for s in rates if shard_host[s] == hot_host]
+        on_hot = [s for s in rates if hot_host in serving[s]]
         donor = max(sorted(on_hot), key=lambda s: rates[s])
         donor_host = hot_host
         _primary, replicas = lineage.hosts_of(donor)
-        excluded = {donor_host, *replicas}
+        excluded = {donor_host, *serving[donor], *replicas}
         candidates = {h: v for h, v in hosts.items() if h not in excluded}
         if not candidates:
             return "no_recipient", imb, None
         recipient = min(sorted(candidates), key=lambda h: candidates[h])
-        # predicted post-move balance: donor reads split across
-        # donor+recipient (replica-read rotation) — max/mean over hosts
+        # predicted post-move balance: donor reads split across its
+        # current serving set PLUS the recipient (replica-read rotation —
+        # what the migration actuator's cutover+rotate executes)
         after = dict(hosts)
-        moved = rates[donor] / 2.0
-        after[donor_host] -= moved
-        after[recipient] = after.get(recipient, 0.0) + moved
+        k = len(serving[donor])
+        shed = rates[donor] / k - rates[donor] / (k + 1)
+        for h in serving[donor]:
+            after[h] -= shed
+        after[recipient] = after.get(recipient, 0.0) + rates[donor] / (k + 1)
         imb_after = self._imbalance(after)
         if imb_after >= imb:
             # a plan that does not move the needle is not a plan — the
@@ -512,13 +545,40 @@ def render_plan(advise: bool = True) -> tuple[str, dict]:
         except Exception as e:
             log_warn(f"placement advise failed: {e!r}")
     st = _advisor.status()
+    # the actuator's in-flight state rides the same surface (lazy import:
+    # runtime/migration.py imports this module at its top level)
+    try:
+        from wukong_tpu.runtime.migration import get_migrator
+
+        mig = get_migrator().status()
+    except Exception:  # the advisor surface must render without the actuator
+        mig = None
     js = {"status": st, "lineage": get_lineage().report(),
-          "inputs": dict(PLACEMENT_INPUTS)}
-    lines = ["wukong-plan  (observe-only placement advisor)", ""]
+          "inputs": dict(PLACEMENT_INPUTS), "migration": mig}
+    lines = ["wukong-plan  (placement advisor"
+             + (" + migration actuator)" if mig and mig["enabled"]
+                else ", observe-only)"), ""]
     lines.append(f"decision {st['decision']}  imbalance "
                  f"{st['imbalance']:.2f} (threshold "
                  f"{max(float(Global.placement_imbalance_x), 1.0):g}, "
                  f"window {Global.placement_window_s}s)")
+    if mig is not None:
+        j = mig["job"] if mig["in_flight"] else None
+        if j is not None:
+            lines.append(
+                f"migration IN FLIGHT: {j['plan_id']} shard "
+                f"{j['donor_shard']} -> host {j['recipient_host']}, "
+                f"phase {j['phase']}, {j['bytes_moved']:,} bytes moved, "
+                f"{j['replayed']} WAL records caught up")
+        elif mig["last"] is not None:
+            j = mig["last"]
+            lines.append(
+                f"last migration: {j['plan_id']} shard "
+                f"{j['donor_shard']} -> host {j['recipient_host']} "
+                f"({j['phase']}"
+                + (f": {j['abort_cause']}" if j["abort_cause"] else "")
+                + f", {j['bytes_moved']:,} bytes, cutover pause "
+                f"{j['cutover_pause_us']}us)")
     p = st["plan"]
     if p is None:
         lines.append("  (no MigrationPlan emitted — imbalance under "

@@ -14,10 +14,16 @@ from dataclasses import dataclass
 
 import numpy as np
 
+from wukong_tpu.analysis.lockdep import declare_leaf, make_lock
 from wukong_tpu.engine.device_store import _next_pow2, build_hash_table
 from wukong_tpu.types import IN, TYPE_ID
 
 INT32_MAX = np.iinfo(np.int32).max
+
+# the migration cutover lock guards the shard->host placement map, the
+# read-rotation registry, and the stores[] swap — plain list/dict stores
+# only, innermost by construction (breaker/staging work runs outside it)
+declare_leaf("migration.cutover")
 
 
 @dataclass
@@ -94,8 +100,21 @@ class ShardedDeviceStore:
         # ("degraded", shard), swept by _rearm_events on recovery so the
         # NEXT episode re-emits
         self._event_noted: dict = {}  # lock-free: atomic dict setdefault/pop
+        # elastic data plane (runtime/migration.py): shard -> serving host
+        # (identity unless a migration moved it) and shard -> demoted
+        # donor copies still serving rotated reads (replica-read rotation,
+        # ROADMAP follow-up j — the plan's predicted-balance model)
+        self._migration_lock = make_lock("migration.cutover")
+        self.placement: dict[int, int] = {}  # lock-free: reads are atomic dict gets on the fetch path; writes publish under _migration_lock (cutover/rollback)
+        self.rotation: dict[int, list] = {}  # lock-free: fetch-path reads see the old or new list, never torn; writes publish under _migration_lock
+        self._rotation_rr: dict[int, int] = {}  # lock-free: racy int bumps only skew the read split by one turn
         if self.replication_factor > 1:
             self.refresh_replicas()
+
+    def host_of(self, i: int) -> int:
+        """The host serving shard ``i``'s primary (identity until a
+        migration moves it)."""
+        return int(self.placement.get(int(i), int(i)))
 
     def refresh_replicas(self) -> None:
         """(Re)clone every shard's replicas from its current primary —
@@ -107,6 +126,20 @@ class ShardedDeviceStore:
             i: [((i + j) % self.D, clone_gstore(self.stores[i]))
                 for j in range(1, self.replication_factor)]
             for i in range(self.D)}
+        if self.rotation:
+            # read-rotation copies (demoted migration donors) mirror the
+            # restored primaries too, keeping their hosts. Clones are
+            # built OUTSIDE the cutover lock (it guards plain dict/list
+            # publications only — a concurrent cutover must never stall
+            # behind a deep copy), then published in one swap
+            with self._migration_lock:
+                snap = {i: [h for (h, _g) in rots]
+                        for i, rots in self.rotation.items()}
+            rebuilt = {i: [(h, clone_gstore(self.stores[i]))
+                           for h in hosts]
+                       for i, hosts in snap.items()}
+            with self._migration_lock:
+                self.rotation = rebuilt
 
     def invalidate_stagings(self) -> None:
         """Drop every staged segment so the next query re-fetches from the
@@ -117,10 +150,12 @@ class ShardedDeviceStore:
         self.bytes_used = 0
 
     def replica_stores(self) -> list:
-        """Every replica GStore (mutation fan-out targets: an insert that
-        reaches a primary must reach its mirrors, or failover would serve
-        stale data)."""
-        return [rg for reps in self.replicas.values() for (_h, rg) in reps]
+        """Every replica GStore plus every read-rotation copy (mutation
+        fan-out targets: an insert that reaches a primary must reach its
+        mirrors, or failover/rotated reads would serve stale data)."""
+        return ([rg for reps in self.replicas.values() for (_h, rg) in reps]
+                + [rg for rots in self.rotation.values()
+                   for (_h, rg) in rots])
 
     def rebuild_shard(self, i: int, store=None, source: str = "replica"
                       ) -> bool:
@@ -156,6 +191,60 @@ class ShardedDeviceStore:
             labels=("shard", "source")).labels(shard=int(i),
                                                source=source).inc()
         return True
+
+    def cutover_shard(self, i: int, store, host: int,
+                      rotate: bool = False) -> None:
+        """Migration read-path cutover (runtime/migration.py, called with
+        the WAL mutation lock held so no batch commit straddles the swap):
+        install ``store`` as shard ``i``'s primary served from ``host``.
+        With ``rotate`` the displaced copy is demoted to a read-rotation
+        replica on its old host — reads split across both copies, the
+        MigrationPlan's predicted-balance model. Then the failover/rebuild
+        promotion mechanics: breaker closed, degradation flags cleared,
+        stagings dropped so the next query fetches the new primary."""
+        # guarded by: _migration_lock — the swap, placement update, and
+        # rotation demotion are one atomic publication to the read path
+        i = int(i)
+        with self._migration_lock:
+            old = self.stores[i]
+            old_host = self.placement.get(i, i)
+            self.stores[i] = store
+            self.placement[i] = int(host)
+            if rotate and old is not store:
+                # APPEND: a re-migrated shard keeps its earlier rotation
+                # copies serving — the advisor's predicted-balance model
+                # grows the serving set k -> k+1, and the executed split
+                # must match what it scored
+                self.rotation[i] = (list(self.rotation.get(i, ()))
+                                    + [(int(old_host), old)])
+        self.breaker.record_success(i)
+        self.degraded_shards.discard(i)
+        self.failover_shards.discard(i)
+        self._rearm_events(i)
+        self.invalidate_stagings()
+
+    def rollback_cutover(self, i: int, donor_store, donor_host) -> None:
+        """Migration abort after a published cutover: swap the donor back
+        as primary on its old host and drop the rotation demotion (called
+        with the WAL mutation lock held, like the cutover itself)."""
+        # guarded by: _migration_lock — the rollback is the same atomic
+        # read-path publication as the cutover it undoes
+        i = int(i)
+        with self._migration_lock:
+            self.stores[i] = donor_store
+            self.placement[i] = int(donor_host if donor_host is not None
+                                    else i)
+            # drop only the entry the cutover demoted (the donor now
+            # reinstated as primary) — earlier migrations' rotation
+            # copies keep serving
+            rots = [(h, g) for (h, g) in self.rotation.get(i, ())
+                    if g is not donor_store]
+            if rots:
+                self.rotation[i] = rots
+            else:
+                self.rotation.pop(i, None)
+        self.breaker.record_success(i)
+        self.invalidate_stagings()
 
     def version(self) -> int:
         """Max dynamic-insert version across all partitions."""
@@ -231,6 +320,16 @@ class ShardedDeviceStore:
         # the access-heat histogram ROADMAP item 3's migration decisions
         # start from. One charge per staging, on the slow host path.
         t0 = get_usec()
+        rots = self.rotation.get(i)
+        if rots:
+            # migrated shard with a demoted donor copy: rotate reads
+            # across the serving copies (replica-read rotation) — the
+            # executed form of the MigrationPlan's predicted balance. A
+            # failed rotation read falls through to the primary path.
+            got = self._fetch_rotation(i, rots, fn)
+            if got is not None:
+                maybe_charge(i, "rotation", got[0], get_usec() - t0)
+                return got[0], True
         try:
             out = retry_call(attempt, site=f"dist.shard_fetch[{i}]",
                              retry_on=(faults.TransientFault,),
@@ -266,6 +365,39 @@ class ShardedDeviceStore:
             self._rearm_events(i)
         maybe_charge(i, "primary", out, get_usec() - t0)
         return out, True
+
+    def _fetch_rotation(self, i: int, rots: list, fn):
+        """One rotated read: every (1 + len(rots))'th turn belongs to the
+        primary (returns None — the caller proceeds down the primary
+        path), the rest to a demoted-donor copy via the replica fetch
+        machinery (its own ``replica.fetch`` fault site + per-(shard,host)
+        breaker key). Returns (value,) on success, None to fall through."""
+        from wukong_tpu.runtime import faults
+        from wukong_tpu.runtime.resilience import retry_call
+        from wukong_tpu.utils.errors import RetryExhausted, ShardUnavailable
+        from wukong_tpu.utils.logger import log_warn
+
+        n = len(rots) + 1
+        c = self._rotation_rr.get(i, 0)
+        self._rotation_rr[i] = c + 1
+        turn = c % n
+        if turn == 0:
+            return None  # the primary's turn in the rotation
+        host, rg = rots[turn - 1]
+
+        def attempt(rg=rg, host=host):
+            faults.site("replica.fetch", shard=host)
+            return fn(rg)
+
+        try:
+            out = retry_call(attempt, site=f"rotation.fetch[{i}@{host}]",
+                             retry_on=(faults.TransientFault,),
+                             breaker=self.breaker, key=(i, host))
+        except (faults.ShardDown, ShardUnavailable, RetryExhausted) as e:
+            log_warn(f"rotation copy {i}@{host} unavailable "
+                     f"({e!r:.80}); serving from the primary")
+            return None
+        return (out,)
 
     def _fetch_failover(self, i: int, fn, what: str):
         """Try shard ``i``'s replicas in successor order; returns (value,)

@@ -58,6 +58,12 @@ plan [-j] [-n]               observe-only placement advisor: run one
                              sweep and print the MigrationPlan + shard
                              lineage (-n skips the fresh sweep; also
                              GET /plan)
+migrate [-j] | migrate -abort | migrate -s
+                             live shard migration: sweep the advisor and
+                             EXECUTE its MigrationPlan (clone/catch-up/
+                             cutover/retire; migration_enable must be
+                             on). -abort rolls the in-flight migration
+                             back to the donor; -s prints actuator status
 metrics [-j]                 dump the metrics registry (Prometheus text, -j JSON)
 checkpoint                   write one atomic checkpoint (partitions + stream
                              state) to checkpoint_dir; truncates covered WAL
@@ -123,6 +129,8 @@ class Console:
                 self._events(rest)
             elif cmd == "plan":
                 self._plan_verb(rest)
+            elif cmd == "migrate":
+                self._migrate(rest)
             elif cmd == "metrics":
                 self._metrics(rest)
             elif cmd == "checkpoint":
@@ -152,17 +160,20 @@ class Console:
 
     def _apply_observatory_knobs(self) -> None:
         """The observatory knobs are runtime-mutable in BOTH directions:
-        the sampler/advisor threads check their knob per tick (on->off),
-        but a flip from off to on after boot needs the idempotent
-        starters re-invoked — without this, `config -s enable_tsdb true`
-        would silently never sample until a restart."""
+        the sampler/advisor/actuator threads check their knob per tick
+        (on->off), but a flip from off to on after boot needs the
+        idempotent starters re-invoked — without this, `config -s
+        enable_tsdb true` (or `migration_enable true`) would silently
+        never act until a restart."""
         from wukong_tpu.obs.placement import maybe_start_advisor
         from wukong_tpu.obs.tsdb import maybe_start_tsdb
+        from wukong_tpu.runtime.migration import maybe_start_migration
 
         maybe_start_tsdb()
         sstore = getattr(self.proxy.dist, "sstore", None) \
             if self.proxy.dist is not None else None
-        maybe_start_advisor(sstore)
+        if maybe_start_migration(sstore, owner=self.proxy) is None:
+            maybe_start_advisor(sstore)
 
     def _sparql(self, rest) -> None:
         ap = argparse.ArgumentParser(prog="sparql")
@@ -396,6 +407,55 @@ class Console:
         if sstore is not None:
             get_advisor().attach_store(sstore)
         self._print_report(ns.j, *render_plan(advise=not ns.n))
+
+    def _migrate(self, rest) -> None:
+        """migrate: one actuator round — sweep the advisor, execute the
+        MigrationPlan it emits (migration_enable must be on). -abort
+        rolls the in-flight migration back; -s prints status only."""
+        import json
+
+        from wukong_tpu.obs.placement import get_advisor
+        from wukong_tpu.runtime.migration import get_migrator
+
+        ap = argparse.ArgumentParser(prog="migrate", prefix_chars="-")
+        ap.add_argument("-abort", dest="abort", action="store_true",
+                        help="abort the in-flight migration")
+        ap.add_argument("-s", dest="status", action="store_true",
+                        help="actuator status only (no sweep)")
+        ap.add_argument("-j", action="store_true", help="JSON output")
+        ns = ap.parse_args(rest)
+        mig = get_migrator()
+        sstore = getattr(self.proxy.dist, "sstore", None) \
+            if self.proxy.dist is not None else None
+        if sstore is not None:
+            mig.attach(sstore=sstore, owner=self.proxy)
+            get_advisor().attach_store(sstore)
+        if ns.abort:
+            job = mig.abort(cause="operator")
+            log_info(f"migration {job.plan.plan_id} aborted"
+                     if job is not None else "no migration in flight")
+            return
+        if ns.status:
+            if ns.j:
+                print(json.dumps(mig.status(), indent=1, sort_keys=True,
+                                 default=str))
+            else:
+                log_info(f"migration actuator: {mig.status()}")
+            return
+        plan = get_advisor().advise_once()
+        if plan is None:
+            log_info("no MigrationPlan to execute (advisor: "
+                     f"{get_advisor().status()['decision']})")
+            return
+        job = mig.run_plan(plan)
+        if ns.j:
+            print(json.dumps(job.to_dict(), indent=1, sort_keys=True,
+                             default=str))
+        else:
+            log_info(f"migration {job.plan.plan_id} {job.phase}: shard "
+                     f"{job.plan.donor_shard} -> host "
+                     f"{job.plan.recipient_host} "
+                     f"({job.bytes_moved:,} bytes)")
 
     def _recover(self, rest) -> None:
         """recover: boot-style checkpoint+WAL restore. recover -d <shard>:

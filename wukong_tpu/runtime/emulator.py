@@ -80,6 +80,34 @@ def load_mix_config(path: str, str_server) -> MixConfig:
     return MixConfig(templates, heavies, weights)
 
 
+def _probe_read(g):
+    """A real host-side partition read with a measurable payload: the
+    partition's largest index list (what an index-origin staging fetches),
+    falling back to an empty array. Shared by the hot-spot and rebalance
+    drills — the rebalance oracle compares THESE bytes across phases."""
+    best = max(((k, v) for k, v in g.index.items() if len(v)),
+               key=lambda kv: len(kv[1]), default=None)
+    return (np.asarray(best[1]) if best is not None
+            else np.empty(0, np.int64))
+
+
+def _zipf_drive(sstore, hot: int, n_ops: int, zipf_a: float, rng,
+                what: str) -> None:
+    """Drive ``n_ops`` probe fetches whose shard choice follows a
+    Zipf(``zipf_a``) law rotated onto ``hot`` (rank-0 mass lands on the
+    hot shard, the tail spreads over the cold ones), through the normal
+    resilience fetch path. One skew model shared by the hot-spot
+    measurement and the rebalance drill's post-move replay — the
+    pre/post imbalance comparison is only meaningful because both runs
+    draw from the SAME law."""
+    D = sstore.D
+    w = 1.0 / np.power(np.arange(1, D + 1, dtype=np.float64), zipf_a)
+    w /= w.sum()
+    order = [(hot + j) % D for j in range(D)]
+    for r in rng.choice(D, size=int(n_ops), p=w):
+        sstore._fetch_shard(order[int(r)], _probe_read, what)
+
+
 class Emulator:
     # consecutive mixed-flight (W>1 cross-class) failures a class may cause
     # before it is pinned to W=1: de-warming alone lets a class re-warm via
@@ -562,27 +590,10 @@ class Emulator:
         tsdb.reset()  # the advisor's trend window starts clean too
         tsdb.sample_once()  # trend-window start marker
         rng = np.random.default_rng(seed)
-        D = sstore.D
-        hot = int(rng.integers(0, D))
-        # Zipf weights over a rotation starting at the hot shard: rank-0
-        # mass lands on `hot`, the tail spreads over the cold shards
-        w = 1.0 / np.power(np.arange(1, D + 1, dtype=np.float64), zipf_a)
-        w /= w.sum()
-        order = [(hot + j) % D for j in range(D)]
-
-        def read_partition(g):
-            # a real host-side read with a measurable payload: the
-            # partition's largest index list (what an index-origin staging
-            # fetches), falling back to an empty array
-            best = max(((k, v) for k, v in g.index.items() if len(v)),
-                       key=lambda kv: len(kv[1]), default=None)
-            return (np.asarray(best[1]) if best is not None
-                    else np.empty(0, np.int64))
-
-        draws = rng.choice(D, size=int(n_ops), p=w)
-        for r in draws:
-            sstore._fetch_shard(order[int(r)], read_partition, "hotspot")
+        hot = int(rng.integers(0, sstore.D))
+        _zipf_drive(sstore, hot, n_ops, zipf_a, rng, "hotspot")
         tsdb.sample_once()  # trend-window end marker
+        D = sstore.D
         report = self.monitor.heat_report(k=D)
         ranked = [r["shard"] for r in report["ranked"]]
         hot_rate = report["shards"][hot]["load_rate_cdf"].get(0.5, 0.0)
@@ -621,6 +632,99 @@ class Emulator:
                 "plan": plan.to_dict() if plan is not None else None,
                 "plan_donor_is_hot": donor_is_hot,
                 "store_untouched": bool(store_untouched)}
+
+    def run_rebalance(self, n_ops: int = 1500, zipf_a: float = 1.6,
+                      seed: int = 0, sstore=None) -> dict:
+        """The hot-spot drill flipped from observe-only to EXECUTED
+        (``bench.py --rebalance``; ROADMAP item 3's elastic acceptance):
+        run :meth:`run_hotspot` to produce the Zipfian skew and the
+        advisor's ``MigrationPlan``, then drive the plan through the live
+        shard-migration actuator (``runtime/migration.py`` —
+        ``migration_enable`` must be on or the executor refuses, the
+        observe-only posture). After every completed phase the migrating
+        shard is probed through the normal resilience fetch path and the
+        payload compared byte-for-byte against a pre-migration oracle —
+        a migration that serves one torn byte fails the drill. Then the
+        SAME skewed workload replays against the post-move placement and
+        the advisor re-scores host imbalance: the drill passes when the
+        post-move max/mean host load-rate ratio drops below
+        ``placement_imbalance_x``. Returns the hotspot report plus
+        {executed, job, probes, queries_identical, imbalance_before,
+        imbalance_after, rebalanced, decision_after, rebalance_gain}.
+        """
+        from wukong_tpu.obs.heat import get_heat
+        from wukong_tpu.obs.placement import MigrationPlan, get_advisor
+        from wukong_tpu.obs.tsdb import get_tsdb
+        from wukong_tpu.runtime.migration import get_migrator
+
+        sstore = sstore if sstore is not None else getattr(
+            self.proxy.dist, "sstore", None)
+        rep = self.run_hotspot(n_ops=n_ops, zipf_a=zipf_a, seed=seed,
+                               sstore=sstore)
+        if rep["plan"] is None:
+            raise WukongError(
+                ErrorCode.UNSUPPORTED_SHAPE,
+                "the rebalance drill needs a MigrationPlan but the "
+                "advisor emitted none — raise the skew or lower "
+                "placement_imbalance_x")
+        plan = MigrationPlan(**rep["plan"])
+        donor = plan.donor_shard
+        # the byte-identical oracle: the probe payload BEFORE any phase
+        # runs (the migration only ever reads the donor, so this stays
+        # the ground truth for every copy that serves the shard)
+        oracle, ok = sstore._fetch_shard(donor, _probe_read, "rebalance")
+        if not ok:
+            raise WukongError(ErrorCode.SHARD_UNAVAILABLE,
+                              f"donor shard {donor} unreadable before "
+                              "the drill even started")
+        probes: dict[str, bool] = {}
+
+        def probe(tag: str) -> None:
+            out, complete = sstore._fetch_shard(donor, _probe_read,
+                                                "rebalance")
+            probes[tag] = bool(complete) and bool(
+                np.array_equal(np.asarray(out), np.asarray(oracle)))
+
+        mig = get_migrator()
+        mig.attach(sstore=sstore, owner=self.proxy)
+        job = mig.run_plan(plan, phase_hook=lambda ph, _job: probe(ph))
+        probe("post")  # one more after the state machine fully settles
+        # replay the SAME skew against the post-move placement and let
+        # the advisor re-score host imbalance over a fresh trend window
+        heat = get_heat()
+        heat.reset()
+        tsdb = get_tsdb()
+        tsdb.reset()
+        tsdb.sample_once()
+        _zipf_drive(sstore, rep["hot"], n_ops, zipf_a,
+                    np.random.default_rng(seed), "rebalance")
+        tsdb.sample_once()
+        advisor = get_advisor()
+        advisor.attach_store(sstore)
+        advisor.advise_once()
+        st = advisor.status()
+        imb_after = float(st["imbalance"])
+        threshold = max(float(Global.placement_imbalance_x), 1.0)
+        identical = bool(probes) and all(probes.values())
+        gain = (plan.imbalance_before / imb_after
+                if imb_after > 0 else float("inf"))
+        log_info(
+            f"rebalance: shard {donor} -> host {plan.recipient_host} "
+            f"({job.bytes_moved / 2**20:.1f} MiB, cutover pause "
+            f"{job.cutover_pause_us}us); imbalance "
+            f"{plan.imbalance_before:.2f} -> {imb_after:.2f} "
+            f"(threshold {threshold:g}, decision {st['decision']}); "
+            f"probes identical={identical} {probes}")
+        # store_untouched was run_hotspot's pre-execution observe-only
+        # proof; the whole point of THIS drill is that the store moved
+        return {**rep, "store_untouched": False,
+                "executed": True, "job": job.to_dict(),
+                "probes": dict(probes), "queries_identical": identical,
+                "imbalance_before": float(plan.imbalance_before),
+                "imbalance_after": imb_after,
+                "rebalanced": imb_after < threshold,
+                "decision_after": st["decision"],
+                "rebalance_gain": gain}
 
     # ------------------------------------------------------------------
     # multi-tenant SLO scenario (ROADMAP item 4 acceptance fixture)

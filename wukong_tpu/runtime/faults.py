@@ -35,6 +35,11 @@ Sites instrumented today:
   (fires before any engine dispatch: an injected failure surfaces as a
   client-visible error reply — the SLO-plane chaos scenario's way of
   burning per-tenant error budgets through the real serving path)
+- ``migration.clone`` / ``migration.catchup`` / ``migration.cutover`` —
+  the shard-migration actuator's phase entries (runtime/migration.py).
+  Each fires BEFORE its phase touches any shared state, so an injected
+  failure aborts the migration cleanly back to the donor (content digest
+  unchanged) or leaves a resumable crash for ``resume()`` to roll forward.
 
 When no plan is installed every hook is a cheap no-op.
 """
@@ -68,6 +73,9 @@ KNOWN_FAULT_SITES = frozenset({
     "join.materialize",    # WCOJ sorted-table materialization (join/wcoj.py)
     "proxy.serve",         # serving-boundary dispatch (runtime/proxy.py;
                            # the SLO-plane chaos scenario's injection point)
+    "migration.clone",     # shard-migration snapshot (runtime/migration.py)
+    "migration.catchup",   # shard-migration WAL-tail replay + dual-write
+    "migration.cutover",   # shard-migration read-path swap
 })
 
 

@@ -168,6 +168,8 @@ class Monitor:
                 log_info(line)
             for line in self.placement_lines():
                 log_info(line)
+            for line in self.migration_lines():
+                log_info(line)
             self._last_print = now
             self._last_cnt = self.cnt
 
@@ -340,6 +342,21 @@ class Monitor:
                 f"({p['bytes_source']}), imbalance "
                 f"{p['imbalance_before']:.2f} -> "
                 f"{p['imbalance_after']:.2f}]"]
+
+    def migration_lines(self) -> list[str]:
+        """Rolling-report line for the shard-migration actuator
+        (runtime/migration.py): the in-flight migration's phase and
+        progress — quiet while nothing is moving."""
+        from wukong_tpu.runtime.migration import get_migrator
+
+        st = get_migrator().status()
+        if not st["in_flight"]:
+            return []
+        j = st["job"]
+        return [f"Migration[{j['plan_id']}: shard {j['donor_shard']} -> "
+                f"host {j['recipient_host']}, {j['phase']}, "
+                f"{j['bytes_moved'] / 2**20:.1f} MiB moved, "
+                f"{j['replayed']} WAL records caught up]"]
 
     def heat_lines(self, k: int = 3) -> list[str]:
         """Rolling-report lines: the top-k hot shards, only when any fetch

@@ -124,7 +124,15 @@ class Proxy:
         maybe_start_tsdb()
         sstore = getattr(dist_engine, "sstore", None)
         if sstore is not None:
-            maybe_start_advisor(sstore)
+            # the migration actuator (runtime/migration.py) attaches
+            # either way (the `migrate` verb works on demand); when its
+            # loop runs (migration_enable + placement_interval_s) it
+            # sweeps the advisor itself, so the observe-only loop is
+            # skipped — one sweeper, not two
+            from wukong_tpu.runtime.migration import maybe_start_migration
+
+            if maybe_start_migration(sstore, owner=self) is None:
+                maybe_start_advisor(sstore)
             # /healthz readiness probe: degraded or failover shards mean
             # the process serves, but not at full strength. The probe
             # holds the store through a weakref: the registry is

@@ -194,6 +194,32 @@ def _merge_seg(seg, ks, vs, dedup: bool) -> DeltaCSRSegment:
     return seg
 
 
+# ---------------------------------------------------------------------------
+# migration dual-write sinks (runtime/migration.py)
+# ---------------------------------------------------------------------------
+# In-flight shard-migration recipients that must observe every committed
+# mutation between catch-up and cutover. Enroll/deroll run under the WAL
+# mutation lock (the migration executor's catch-up/cutover critical
+# sections), and every consulting write path — insert_batch_into below,
+# StreamIngestor.commit_epoch — reads the dict INSIDE the same lock, so an
+# enrolled recipient can never miss, or double-observe, a committed batch.
+_MIGRATION_SINKS: dict = {}  # guarded by: mutation_lock()
+
+
+def enroll_migration_sink(key, store) -> None:  # caller holds: mutation_lock()
+    _MIGRATION_SINKS[key] = store
+
+
+def deroll_migration_sink(key) -> None:  # caller holds: mutation_lock()
+    _MIGRATION_SINKS.pop(key, None)
+
+
+def migration_sinks() -> list:  # caller holds: mutation_lock()
+    """The current dual-write targets (empty list when no migration is in
+    flight — the common case pays one dict check per batch)."""
+    return list(_MIGRATION_SINKS.values())
+
+
 def load_dir_into(stores: list[GStore], dirname: str, dedup: bool = True) -> int:
     """`load -d <dir>`: read id-triple files and insert into every partition
     (the RDFEngine::execute_load_data path, core/engine/rdf.hpp)."""
@@ -220,4 +246,10 @@ def insert_batch_into(stores: list[GStore], triples: np.ndarray,
         total = 0
         for g in stores:
             total += insert_triples(g, triples, dedup, check_ids=False)
+        # dual-write: an in-flight migration's recipient mirrors the batch
+        # (each sink hashes out its own shard's rows). Excluded from the
+        # returned total: the count answers "how many new edges landed",
+        # and the sink is a transient mirror of a store already counted
+        for g in migration_sinks():
+            insert_triples(g, triples, dedup, check_ids=False)
         return total

@@ -26,7 +26,7 @@ import numpy as np
 from wukong_tpu.obs.metrics import get_registry
 from wukong_tpu.obs.recorder import get_recorder
 from wukong_tpu.obs.trace import activate, maybe_start_trace
-from wukong_tpu.store.dynamic import insert_triples
+from wukong_tpu.store.dynamic import insert_triples, migration_sinks
 from wukong_tpu.utils.errors import ErrorCode, WukongError
 from wukong_tpu.utils.timer import get_usec
 
@@ -223,6 +223,14 @@ class StreamIngestor:
             for g in self.stores:
                 inserted[0] += insert_triples(g, triples, dedup=self.dedup,
                                               check_ids=False)
+            # migration_sinks() read under the mutation lock this commit
+            # holds: an epoch committed during a shard migration's
+            # dual-write window reaches the recipient too (no epoch
+            # lost). Excluded from the inserted count — the sink is a
+            # transient mirror of a store already counted
+            for g in migration_sinks():
+                insert_triples(g, triples, dedup=self.dedup,
+                               check_ids=False)
             return inserted[0]
 
         with mutation_lock(), activate(trace):
