@@ -991,6 +991,98 @@ def rebalance_main(device_ok: bool) -> None:
     }, "BENCH_REBALANCE.json")
 
 
+def readmostly_main(device_ok: bool) -> None:
+    """`bench.py --readmostly`: the Zipfian read-mostly serving-cache
+    drill (Emulator.run_readmostly — ROADMAP item 7's acceptance fixture,
+    observe-only). Closed-loop template+const reads drawn Zipf over ~400
+    instances of four LUBM light-template families (up to 128 constants
+    each — the exact count rides the artifact's knobs.templates; some
+    predicates have fewer anchors) through proxy.serve_query, once
+    per write-rate phase (0 / 2% / 8% dynamic-insert batches per read).
+    Headline: `predicted_hit_rate` — the zero-write phase's shadow-cache
+    hit rate, i.e. what a version-keyed result cache (plan signature +
+    consts + store version) would have served without executing. The
+    drill FAILS unless the skewed mix predicts >= 0.5, hit rate degrades
+    monotonically as the write rate rises, and the store content digest
+    is bit-identical across the read-only phase (the observatory touched
+    nothing). Artifact: BENCH_READMOSTLY.json (ratio unit — trended by
+    scripts/bench_report.py, never direction-gated)."""
+    import numpy as np
+
+    from wukong_tpu.config import Global
+    from wukong_tpu.engine.cpu import CPUEngine
+    from wukong_tpu.loader.lubm import UB, VirtualLubmStrings, generate_lubm
+    from wukong_tpu.planner.optimizer import make_planner
+    from wukong_tpu.runtime.emulator import Emulator
+    from wukong_tpu.runtime.proxy import Proxy
+    from wukong_tpu.store.gstore import build_partition
+    from wukong_tpu.types import OUT
+
+    # a private world (not _ensure_world's cache): the write phases
+    # append duplicate edges, and a mutated store must never leak into
+    # the other benches' cached partitions
+    triples, _ = generate_lubm(1, seed=42)
+    g = build_partition(triples, 0, 1)
+    ss = VirtualLubmStrings(1, seed=42)
+    proxy = Proxy(g, ss, cpu_engine=CPUEngine(g, ss),
+                  planner=make_planner(triples))
+    # several template FAMILIES (distinct plan-cache signatures), each
+    # instantiated over many constants: the Zipf draw over the flat list
+    # piles mass on the first family's hot constants, so the ledger sees
+    # a skewed TEMPLATE ranking (zipf_alpha) on top of the skewed
+    # per-key ranking the shadow cache sees
+    texts = []
+    for pred in ("advisor", "takesCourse", "memberOf", "teacherOf"):
+        pid = ss.str2id(f"<{UB}{pred}>")
+        anchors = np.asarray(g.get_index(pid, OUT))
+        texts += [f"SELECT ?s WHERE {{ ?s <{UB}{pred}> "
+                  f"{ss.id2str(int(a))} . }}" for a in anchors[:128]]
+    for t in texts[::128]:  # warm parse/plan caches before the drill
+        proxy.serve_query(t, blind=True)
+    rng = np.random.default_rng(7)
+    write_pool = triples[rng.integers(0, len(triples), 4096)]
+    emu = Emulator(proxy)
+    zipf_a = float(os.environ.get("WUKONG_READMOSTLY_ZIPF", "1.2"))
+    rep = emu.run_readmostly(texts, reads=600, warmup_reads=300,
+                             write_rates=(0.0, 0.02, 0.08),
+                             zipf_a=zipf_a, seed=7,
+                             write_batch=write_pool,
+                             tenants=["gold", "bulk"])
+    ok = (rep["predicted_hit_rate"] is not None
+          and rep["predicted_hit_rate"] >= 0.5
+          and rep["degrades"] and rep["store_untouched"])
+    if not ok:
+        raise SystemExit(
+            f"readmostly drill FAILED: predicted_hit_rate="
+            f"{rep['predicted_hit_rate']} degrades={rep['degrades']} "
+            f"store_untouched={rep['store_untouched']}")
+    _emit_final({
+        "metric": "LUBM-1 Zipfian read-mostly drill: achievable "
+                  "version-keyed result-cache hit rate on the skewed "
+                  "template mix (observe-only shadow cache; zero-write "
+                  "phase), with write-rate degradation phases",
+        "value": rep["predicted_hit_rate"],
+        "unit": "ratio",
+        "predicted_hit_rate": rep["predicted_hit_rate"],
+        "degrades": rep["degrades"],
+        "store_untouched": rep["store_untouched"],
+        "zipf_alpha_est": rep["zipf_alpha"],
+        "backend": "cpu",  # host serving path; no device work
+        "detail": {
+            "phases": rep["phases"],
+            "bytes_saved": rep["bytes_saved"],
+            "uncacheable_by_reason": rep["uncacheable_by_reason"],
+            "trend": rep["trend"],
+            "knobs": {"shadow_cache_size": Global.shadow_cache_size,
+                      "reuse_sample_every": Global.reuse_sample_every,
+                      "reuse_templates_max": Global.reuse_templates_max,
+                      "zipf_a": zipf_a, "templates": len(texts)},
+            "top_templates": rep["report"]["popularity"]["ranked"][:4],
+            "dataset": DATASET_NOTES["lubm"],
+        },
+    }, "BENCH_READMOSTLY.json")
+
+
 def cyclic_main(device_ok: bool) -> None:
     """`bench.py --cyclic`: the cyclic workload suite (triangle / diamond /
     4-clique synthetic worlds + the WatDiv-based cyclic query set), each
@@ -2323,6 +2415,9 @@ def main():
         return
     if "--rebalance" in sys.argv:
         rebalance_main(device_ok)
+        return
+    if "--readmostly" in sys.argv:
+        readmostly_main(device_ok)
         return
     if "--watdiv" in sys.argv:
         watdiv_main(device_ok)

@@ -70,6 +70,15 @@ def _headline(d: dict) -> dict | None:
     if isinstance(d.get("rebalance_gain"), (int, float)):
         return {"value": float(d["rebalance_gain"]), "unit": "x",
                 "metric": "rebalance_gain"}
+    # read-mostly serving-cache drill: the achievable version-keyed
+    # result-cache hit rate on the Zipfian mix (BENCH_READMOSTLY.json;
+    # unit "ratio" is direction-less — the drill self-gates at >= 0.5
+    # with monotone write-rate degradation, so it is trended but never
+    # threshold-checked here). Before the generic value branch for the
+    # same reason as hotspot_separation
+    if isinstance(d.get("predicted_hit_rate"), (int, float)):
+        return {"value": float(d["predicted_hit_rate"]), "unit": "ratio",
+                "metric": "predicted_hit_rate"}
     if isinstance(d.get("value"), (int, float)):
         return {"value": float(d["value"]), "unit": d.get("unit", ""),
                 "metric": str(d.get("metric", ""))[:160]}

@@ -22,6 +22,12 @@ echo "== elastic rebalance drill (executed shard migration) =="
 # host imbalance under placement_imbalance_x (exits non-zero otherwise)
 JAX_PLATFORMS=cpu python bench.py --rebalance
 
+echo "== read-mostly serving-cache drill (shadow hit-rate acceptance) =="
+# the Zipfian read-mostly closed loop: predicted shadow-cache hit rate
+# >= 0.5 on the skewed mix, monotone degradation under write pressure,
+# store digest bit-untouched (exits non-zero otherwise)
+JAX_PLATFORMS=cpu python bench.py --readmostly
+
 echo "== bench trajectory check =="
 python scripts/bench_report.py --check
 

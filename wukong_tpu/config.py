@@ -259,6 +259,30 @@ class GlobalConfig:
     # alert + one dumped trace per window, not a storm
     slo_dump_cooldown_s: int = 60
 
+    # ---- serving-cache observatory (obs/reuse.py; all mutable) ----
+    # template popularity ledger + observe-only shadow cache charged at
+    # the proxy reply point: per-template windowed arrival rates with
+    # tenant attribution, a Zipf-skew estimate, and a version-keyed
+    # shadow key ring (key = plan signature + consts + store version,
+    # ROADMAP item 7's exact cache key) simulating hit/miss/evict/
+    # invalidate WITHOUT storing results. Default ON: the per-reply cost
+    # is a few leaf-lock updates (BENCH_SERVE.json
+    # detail.reuse_observatory); off degrades every hook — including the
+    # store-mutation invalidation notes — to one knob check.
+    enable_reuse: bool = True
+    # per-template arrival samples kept for the windowed rate
+    reuse_window: int = 512
+    # bounded template-label cardinality: past this many distinct
+    # templates, new ones land in the "__overflow__" bucket
+    reuse_templates_max: int = 256
+    # shadow key ring capacity (the simulated cache's entry budget — the
+    # reported hit rate is what a real cache of THIS size would achieve)
+    shadow_cache_size: int = 4096
+    # sample the shadow probe 1-in-N replies (1 = every reply, the
+    # default; raise only if the probe outgrows the leaf-lock budget on
+    # the serving micro — the ledger charge always runs)
+    reuse_sample_every: int = 1
+
     # ---- concurrency checking (wukong_tpu/analysis/lockdep.py) ----
     # lockdep-style runtime lock-order checker: locks created through the
     # analysis.lockdep factories become Debug wrappers that record the

@@ -20,6 +20,9 @@ and every perf PR after this one stands on:
 - placement.py— ShardLineage ledger + the observe-only PlacementAdvisor
   emitting literal ``MigrationPlan`` artifacts (/plan) — ROADMAP item
   3's decision substrate
+- reuse.py    — serving-cache observatory: template popularity ledger,
+  observe-only shadow cache, and invalidation telemetry
+  (``CACHE_INPUTS``, /cache) — ROADMAP item 7's decision substrate
 
 Config knobs (all runtime-mutable, config.py): ``enable_tracing`` (default
 off — the hot path pays one getattr), ``trace_sample_every``,

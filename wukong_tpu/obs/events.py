@@ -21,6 +21,8 @@ decisions (each a one-knob-check hook when ``enable_events`` is off):
 - slo — ``slo.burn`` (the burn sentinel)
 - profile — ``latency.regression`` (the regression sentinel)
 - recorder — ``trace.dump`` (auto-dumps that no other event triggered)
+- reuse — ``cache.invalidate`` (store-mutation version edges with their
+  shadow-key kill counts — the serving-cache observatory)
 
 FlightRecorder dumps reference the *triggering* event id (``SLO_BURN``
 dumps carry their ``slo.burn`` event's id), so an anomaly dump and its
@@ -48,6 +50,9 @@ EVENT_KINDS = (
     "shard.rebuild", "shard.heal", "checkpoint.write", "recovery.restore",
     "recovery.replay", "wal.rotate", "wal.torn_tail", "slo.burn",
     "latency.regression", "trace.dump",
+    # serving-cache observatory (obs/reuse.py): one event per
+    # store-mutation version edge, carrying the edge + shadow-key kills
+    "cache.invalidate",
     # the shard-migration actuator's phase transitions
     # (runtime/migration.py; correlate with -K shard.migrate)
     "shard.migrate.start", "shard.migrate.catchup",

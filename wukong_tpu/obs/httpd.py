@@ -92,7 +92,8 @@ class _MetricsHandler(BaseHTTPRequestHandler):
             ctype = "application/json"
         elif path in ("/top", "/top.json", "/slo", "/slo.json",
                       "/history", "/history.json", "/events",
-                      "/events.json", "/plan", "/plan.json"):
+                      "/events.json", "/plan", "/plan.json",
+                      "/cache", "/cache.json"):
             # top(1) for shards / templates / lanes (obs/profile.py), the
             # tenant SLO + overload-signal report (obs/slo.py), and the
             # observatory plane: metrics trend windows (obs/tsdb.py), the
@@ -110,6 +111,13 @@ class _MetricsHandler(BaseHTTPRequestHandler):
                 from wukong_tpu.obs.slo import render_slo
 
                 text, js = render_slo(k)
+            elif path.startswith("/cache"):
+                # the serving-cache observatory: shadow hit rate, template
+                # popularity + cacheability verdicts, invalidation trend
+                # (obs/reuse.py — ROADMAP item 7's decision surface)
+                from wukong_tpu.obs.reuse import render_cache
+
+                text, js = render_cache(k)
             elif path.startswith("/history"):
                 from wukong_tpu.obs.tsdb import render_history
 
@@ -186,7 +194,7 @@ def maybe_start_metrics_http(port: int | None = None):
         _server = srv
         log_info(f"metrics http endpoint on :{srv.server_address[1]} "
                  "(/metrics, /metrics.json, /top, /slo, /history, "
-                 "/events, /plan, /healthz)")
+                 "/events, /plan, /cache, /healthz)")
         return srv
 
 

@@ -471,11 +471,15 @@ def template_key(q, text: str) -> str:
 def render_top(k: int | None = None) -> tuple[str, dict]:
     """(plain-text table, JSON dict) for the /top endpoint and the ``top``
     console verb — top(1) for shards, templates, and scheduler lanes."""
+    from wukong_tpu.obs.reuse import cache_hit_rates
+
     kk = k if k is not None else max(int(Global.top_k), 1)
     heat = get_heat().report(kk)
     templates = get_attributor().report(kk)
     lanes = _lane_depths()
-    js = {"shards": heat, "templates": templates, "lanes": lanes}
+    caches = cache_hit_rates()
+    js = {"shards": heat, "templates": templates, "lanes": lanes,
+          "caches": caches}
 
     lines = [f"wukong-top  (top {kk} per section)", ""]
     lines.append("SHARDS by fetches "
@@ -508,6 +512,18 @@ def render_top(k: int | None = None) -> tuple[str, dict]:
     if not templates:
         lines.append("  (no attributed samples — enable_attribution + "
                      "enable_tracing to populate)")
+
+    def _rate(c):
+        return ("-" if c["hit_rate"] is None
+                else format(c["hit_rate"], ".1%"))
+
+    shadow_hr = caches["shadow"]["hit_rate"]
+    lines.append(
+        f"  caches: parse {_rate(caches['parse'])} "
+        f"({caches['parse']['total']:,})  plan {_rate(caches['plan'])} "
+        f"({caches['plan']['total']:,})  shadow "
+        + ("-" if shadow_hr is None else format(shadow_hr, ".1%")
+           ) + "  (GET /cache for the full observatory)")
     lines.append("")
     lines.append("LANES")
     for name, v in lanes.items():

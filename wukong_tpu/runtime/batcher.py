@@ -95,9 +95,14 @@ _M_OCCUPANCY = get_registry().histogram(
     "wukong_batch_occupancy", "Group size at flush",
     buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024))
 _M_PLAN_CACHE = get_registry().counter(
-    "wukong_plan_cache_total", "Plan cache lookups", labels=("outcome",))
+    "wukong_plan_cache_total",
+    "Plan cache outcomes (hit/miss per lookup; uncacheable per refused "
+    "shape; invalidated per entry dropped by a stale recipe or a clear)",
+    labels=("result",))
 _M_PARSE_CACHE = get_registry().counter(
-    "wukong_parse_cache_total", "Parse cache lookups", labels=("outcome",))
+    "wukong_parse_cache_total",
+    "Parse cache outcomes (hit/miss per lookup; uncacheable per "
+    "unpicklable parse artifact)", labels=("result",))
 
 # heavy-lane observability: fused heavy dispatch counts, split fan-out, and
 # the group-size histogram feed the /top lane view and the Monitor's
@@ -230,12 +235,16 @@ class PlanCache:
             return False
         recipe = self._lru.get((sig, version))
         if recipe is None:
-            _M_PLAN_CACHE.labels(outcome="miss").inc()
+            _M_PLAN_CACHE.labels(result="miss").inc()
             return False
         if not apply_plan_recipe(q, recipe):
-            _M_PLAN_CACHE.labels(outcome="miss").inc()
+            # an entry existed but could not apply (stale/foreign recipe):
+            # that is an invalidation event, not a cold miss — drop it so
+            # the next lookup misses cleanly instead of re-failing
+            self._lru.pop((sig, version))
+            _M_PLAN_CACHE.labels(result="invalidated").inc()
             return False
-        _M_PLAN_CACHE.labels(outcome="hit").inc()
+        _M_PLAN_CACHE.labels(result="hit").inc()
         return True
 
     def record(self, parsed_patterns, q: SPARQLQuery, sig, version: int) -> None:
@@ -244,6 +253,11 @@ class PlanCache:
         recipe = build_plan_recipe(parsed_patterns, q)
         if recipe is not None:
             self._lru.put((sig, version), recipe)
+        else:
+            # planner-empty / corun / ambiguous-const shapes: the plan is
+            # not safely replayable — the serving-cache observatory
+            # mirrors exactly this refusal set (obs/reuse.py classify)
+            _M_PLAN_CACHE.labels(result="uncacheable").inc()
 
     def put_aux(self, kind: str, sig, version, value) -> None:
         """Overwrite one auxiliary plan fact (the WCOJ measured-blowup
@@ -269,6 +283,11 @@ class PlanCache:
         return v
 
     def clear(self) -> None:
+        n = len(self._lru)
+        if n:
+            # a store-change clear (dynamic load / stream commit /
+            # restore) invalidates every cached recipe and aux fact
+            _M_PLAN_CACHE.labels(result="invalidated").inc(n)
         self._lru.clear()
 
     def stats(self) -> dict:

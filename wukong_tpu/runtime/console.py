@@ -54,6 +54,9 @@ events [-k <n>] [-s <shard>] [-K <kind>] [-j]
                              cluster event journal: breaker trips,
                              failovers, heals, WAL/checkpoint lifecycle,
                              SLO burns (also GET /events)
+cache [-k <n>] [-j]          serving-cache observatory: shadow hit rate,
+                             template popularity + cacheability verdicts,
+                             invalidation trend (also GET /cache)
 plan [-j] [-n]               observe-only placement advisor: run one
                              sweep and print the MigrationPlan + shard
                              lineage (-n skips the fresh sweep; also
@@ -127,6 +130,8 @@ class Console:
                 self._history(rest)
             elif cmd == "events":
                 self._events(rest)
+            elif cmd == "cache":
+                self._cache(rest)
             elif cmd == "plan":
                 self._plan_verb(rest)
             elif cmd == "migrate":
@@ -391,6 +396,17 @@ class Console:
         ns = ap.parse_args(rest)
         self._print_report(ns.j, *render_events(ns.k, shard=ns.s,
                                                 kind=ns.K))
+
+    def _cache(self, rest) -> None:
+        """cache: the serving-cache observatory (the /cache body)."""
+        from wukong_tpu.obs.reuse import render_cache
+
+        ap = argparse.ArgumentParser(prog="cache")
+        ap.add_argument("-k", type=int, default=None,
+                        help="template rows shown (default: the top_k knob)")
+        ap.add_argument("-j", action="store_true", help="JSON output")
+        ns = ap.parse_args(rest)
+        self._print_report(ns.j, *render_cache(ns.k))
 
     def _plan_verb(self, rest) -> None:
         """plan: one observe-only placement-advisor sweep + the last

@@ -727,6 +727,157 @@ class Emulator:
                 "rebalance_gain": gain}
 
     # ------------------------------------------------------------------
+    # read-mostly serving-cache scenario (ROADMAP item 7 acceptance
+    # fixture — obs/reuse.py's decision substrate)
+    # ------------------------------------------------------------------
+    def run_readmostly(self, texts: list, reads: int = 600,
+                       warmup_reads: int = 200,
+                       write_rates=(0.0, 0.02, 0.08),
+                       zipf_a: float = 1.1, seed: int = 0,
+                       write_batch=None, batch_rows: int = 48,
+                       tenants: list | None = None) -> dict:
+        """The Zipfian read-mostly closed loop: template+const reads drawn
+        Zipf(``zipf_a``) over ``texts`` through the REAL serving entry
+        (``serve_query``), replayed once per ``write_rates`` phase with
+        that many writes interleaved per read (0.02 = one dynamic insert
+        batch per 50 reads). Every reply charges the serving-cache
+        observatory, so each phase's shadow-cache hit rate is what a
+        version-keyed result cache (key = plan signature + consts + store
+        version) would have achieved under that write pressure — item 7's
+        acceptance numbers, measured before the cache exists.
+
+        Three proofs ride along (the ``run_hotspot`` posture):
+
+        - the zero-write phase's hit rate is ``predicted_hit_rate`` (the
+          headline; the skewed mix must clear the cache's economic bar),
+        - the store content digest is bit-identical across that phase —
+          the ledger + shadow simulation read everything and touch
+          nothing,
+        - hit rate degrades monotonically as the write rate rises (every
+          insert bumps the version the keys carry; ``degrades`` is the
+          ordered-phase check), with the write-side ``cache.invalidate``
+          events on the same timeline as the reads.
+
+        ``write_batch`` is an [N,3] triple pool writes sample from
+        (``batch_rows`` rows per insert, appended non-dedup so every
+        batch is a real version edge); phases with a positive write rate
+        require it. ``tenants`` rotates reply attribution across the
+        given tenant names (default single-tenant).
+        """
+        from wukong_tpu.obs.reuse import get_reuse, reuse_trend
+        from wukong_tpu.obs.tsdb import get_tsdb
+        from wukong_tpu.store.dynamic import insert_batch_into
+        from wukong_tpu.store.persist import gstore_digest
+
+        if any(w > 0 for w in write_rates) and write_batch is None:
+            raise WukongError(ErrorCode.SYNTAX_ERROR,
+                              "write_rates > 0 need a write_batch pool")
+        obs = get_reuse()
+        obs.reset()
+        tsdb = get_tsdb()
+        tsdb.reset()
+        tsdb.sample_once()  # trend-window start marker
+        rng = np.random.default_rng(seed)
+        n = len(texts)
+        w = 1.0 / np.power(np.arange(1, n + 1, dtype=np.float64), zipf_a)
+        w /= w.sum()
+        tens = tenants or ["default"]
+        g = self.proxy.g
+
+        def serve_one(k: int) -> bool:
+            text = texts[int(rng.choice(n, p=w))]
+            try:
+                q = self.proxy.serve_query(text, blind=True,
+                                           tenant=tens[k % len(tens)])
+                return q.result.status_code == ErrorCode.SUCCESS
+            except Exception:
+                return False
+
+        phases = []
+        store_untouched = None
+        for write_rate in write_rates:
+            every = int(round(1.0 / write_rate)) if write_rate > 0 else 0
+            if write_rate == 0 and store_untouched is None:
+                # the observe-only proof brackets THIS phase (warmup +
+                # measurement are both pure reads), wherever it sits in
+                # the write_rates ordering
+                digest0 = gstore_digest(g)
+                version0 = int(getattr(g, "version", 0))
+            # warm the shadow population for THIS phase's steady state
+            # (uncounted — the hit rate models a long-running cache, not
+            # its cold start)
+            for k in range(warmup_reads):
+                serve_one(k)
+            s0 = obs.shadow.stats()
+            served = errors = writes = 0
+            t0 = get_usec()
+            for k in range(reads):
+                if serve_one(k):
+                    served += 1
+                else:
+                    errors += 1
+                if every and (k + 1) % every == 0:
+                    rows = write_batch[rng.integers(
+                        0, len(write_batch), batch_rows)]
+                    insert_batch_into(self.proxy._insert_targets(), rows,
+                                      dedup=False)
+                    writes += 1
+            dur_s = max((get_usec() - t0) / 1e6, 1e-9)
+            s1 = obs.shadow.stats()
+            probes = (s1["hits"] + s1["misses"]
+                      - s0["hits"] - s0["misses"])
+            hits = s1["hits"] - s0["hits"]
+            phases.append({
+                "write_rate": float(write_rate),
+                "reads": reads, "served": served, "errors": errors,
+                "writes": writes,
+                "qps": round(reads / dur_s, 1),
+                "probes": probes, "hits": hits,
+                "hit_rate": round(hits / probes, 4) if probes else None,
+                "keys_killed": s1["killed"] - s0["killed"],
+            })
+            if write_rate == 0 and store_untouched is None:
+                # the observe-only proof: a full read phase (ledger +
+                # shadow probes on every reply) left the store
+                # bit-identical — content CRC and version both
+                store_untouched = (
+                    gstore_digest(g) == digest0
+                    and int(getattr(g, "version", 0)) == version0)
+        tsdb.sample_once()  # trend-window end marker
+        # monotone degradation within a small jitter tolerance: compared
+        # in WRITE-RATE order (not tuple order — a caller may interleave
+        # phases), more write pressure must never serve a better hit rate
+        rates = [p["hit_rate"]
+                 for p in sorted(phases, key=lambda p: p["write_rate"])
+                 if p["hit_rate"] is not None]
+        degrades = all(b <= a + 0.05 for a, b in zip(rates, rates[1:]))
+        predicted = next((p["hit_rate"] for p in phases
+                          if p["write_rate"] == 0), None)
+        rep = obs.report(k=8)
+        out = {
+            "predicted_hit_rate": predicted,
+            "phases": phases,
+            "degrades": bool(degrades),
+            "store_untouched": bool(store_untouched)
+            if store_untouched is not None else None,
+            "zipf_alpha": rep["popularity"]["zipf_alpha"],
+            "bytes_saved": rep["shadow"]["bytes_saved"],
+            "uncacheable_by_reason": rep["uncacheable_by_reason"],
+            "trend": reuse_trend(),
+            "report": rep,
+        }
+        log_info(
+            "readmostly: predicted hit rate "
+            + ("-" if predicted is None else f"{predicted:.1%}")
+            + f" on Zipf({zipf_a}) x{n} templates; phases "
+            + " ".join(f"w={p['write_rate']:g}:"
+                       + ("-" if p["hit_rate"] is None
+                          else f"{p['hit_rate']:.0%}")
+                       for p in phases)
+            + f"; degrades={degrades}, store untouched={store_untouched}")
+        return out
+
+    # ------------------------------------------------------------------
     # multi-tenant SLO scenario (ROADMAP item 4 acceptance fixture)
     # ------------------------------------------------------------------
     def run_tenants(self, texts: list, duration_s: float = 3.0,

@@ -428,6 +428,16 @@ class MigrationExecutor:
             self._rebind_targets()
         job.cutover_pause_us = get_usec() - t0
         get_lineage().observe_store(ss)  # post-move lineage, immediately
+        # cache-coherence telemetry (obs/reuse.py): a read-path swap is a
+        # conservative full purge for a version-keyed result cache (the
+        # shard's version counter travels with the byte-identical clone,
+        # so a version-diff kill would see no edge — the swap itself is
+        # the invalidation). Outside the mutation lock, after the pause
+        # measurement: pure observability
+        from wukong_tpu.obs.reuse import maybe_note_invalidation
+
+        maybe_note_invalidation("cutover", version=None, shard=donor,
+                                plan=job.plan.plan_id)
         ev = emit_event("shard.migrate.cutover", shard=donor,
                         plan=job.plan.plan_id,
                         recipient_host=job.plan.recipient_host,

@@ -170,6 +170,8 @@ class Monitor:
                 log_info(line)
             for line in self.migration_lines():
                 log_info(line)
+            for line in self.cache_lines():
+                log_info(line)
             self._last_print = now
             self._last_cnt = self.cnt
 
@@ -357,6 +359,31 @@ class Monitor:
                 f"host {j['recipient_host']}, {j['phase']}, "
                 f"{j['bytes_moved'] / 2**20:.1f} MiB moved, "
                 f"{j['replayed']} WAL records caught up]"]
+
+    def cache_lines(self) -> list[str]:
+        """Rolling-report line for the serving-cache observatory
+        (obs/reuse.py): shadow hit rate, resident keys, invalidation
+        kills, and the hottest template's share — quiet until any reply
+        has been observed (reuse off or no serving traffic)."""
+        from wukong_tpu.obs.reuse import get_reuse
+
+        obs = get_reuse()
+        sh = obs.shadow.stats()
+        if sh["hits"] + sh["misses"] == 0:
+            return []
+        pop = obs.ledger.report(k=1)
+        hot = ""
+        if pop["ranked"]:
+            r = pop["ranked"][0]
+            hot = (f", top {r['template']} {r['share']:.0%} "
+                   f"@{r['rate_qps']:,.0f}q/s")
+        hr = sh["hit_rate"]
+        return [f"Cache[shadow "
+                + ("-" if hr is None else f"{hr:.1%}")
+                + f" over {sh['hits'] + sh['misses']:,} probes, "
+                f"{sh['keys']} keys, {sh['killed']:,} killed, "
+                f"saved {sh['bytes_saved'] / 2**20:.1f} MiB"
+                f"{hot}]"]
 
     def heat_lines(self, k: int = 3) -> list[str]:
         """Rolling-report lines: the top-k hot shards, only when any fetch

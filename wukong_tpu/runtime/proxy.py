@@ -27,11 +27,13 @@ from wukong_tpu.obs import (
     maybe_start_metrics_http,
     maybe_start_trace,
 )
+from wukong_tpu.obs.reuse import maybe_observe_reuse
 from wukong_tpu.obs.slo import get_overload, get_slo, tenant_label
 from wukong_tpu.planner.heuristic import heuristic_plan
 from wukong_tpu.planner.plan_file import set_plan
 from wukong_tpu.runtime.batcher import (
     _M_PARSE_CACHE,
+    _M_PLAN_CACHE,
     PlanCache,
     QueryBatcher,
     snapshot_patterns,
@@ -183,15 +185,15 @@ class Proxy:
 
         blob = self._parse_cache.get(text)
         if blob is not None:
-            _M_PARSE_CACHE.labels(outcome="hit").inc()
+            _M_PARSE_CACHE.labels(result="hit").inc()
             return pickle.loads(blob)
-        _M_PARSE_CACHE.labels(outcome="miss").inc()
+        _M_PARSE_CACHE.labels(result="miss").inc()
         q = Parser(self.str_server).parse(text)
         try:
             self._parse_cache.put(
                 text, pickle.dumps(q, protocol=pickle.HIGHEST_PROTOCOL))
         except Exception:  # unpicklable artifact: skip caching, stay correct
-            pass
+            _M_PARSE_CACHE.labels(result="uncacheable").inc()
         return q
 
     def _plan_version(self):
@@ -212,8 +214,21 @@ class Proxy:
         # the recorded plan recipe (dynamic inserts / stream commits bump
         # the version, so stale plans never apply)
         sig = template_signature(q)
+        # stashed for the reply-side reuse observatory: classify() reuses
+        # the plan-time signature instead of re-walking the patterns
+        # (the largest single component of the per-reply hook cost), and
+        # the shadow key must carry the version the read EXECUTES under —
+        # a write committing between plan and reply would otherwise file
+        # the key under the new version and credit hits a real cache
+        # could not have served
+        q._tsig = sig
         version = self._plan_version()
-        if sig is not None and self._plan_cache.lookup(q, sig, version):
+        q._rver = version[0]
+        if sig is None:
+            # unions/optionals/empty groups plan recursively — shapes the
+            # recipe cache (and the item-7 result cache) cannot key
+            _M_PLAN_CACHE.labels(result="uncacheable").inc()
+        elif self._plan_cache.lookup(q, sig, version):
             return
         parsed = snapshot_patterns(q) if sig is not None else None
         if self.planner is not None and Global.enable_planner:
@@ -312,6 +327,10 @@ class Proxy:
         self._observe_slo(ten, get_usec() - t0_us,
                           ok=status == ErrorCode.SUCCESS, status=status,
                           trace=trace)
+        # serving-cache observatory (obs/reuse.py): template popularity +
+        # the observe-only shadow-cache probe, charged at the reply point
+        # against the store version the read executed under
+        self._observe_reuse(q, ten, text)
         if q.result.status_code != ErrorCode.SUCCESS:
             if not q.result.complete:
                 # structured partial reply, not a crash: the rows produced
@@ -405,6 +424,18 @@ class Proxy:
         elif status == ErrorCode.BUDGET_EXCEEDED:
             sig.note_shed("reply_budget", tenant)
         get_slo().observe(tenant, int(dur_us), ok, trace=trace)
+
+    def _observe_reuse(self, q, tenant: str, text: str) -> None:
+        """Reply-side reuse-observatory hook: the shadow key carries the
+        PLAN-time store version (``_rver``, stashed where the plan cache
+        read it), so a write landing between plan and reply cannot file
+        the key under a version the read never saw. Queries that skipped
+        the plan path (user plan files) fall back to the current
+        version."""
+        maybe_observe_reuse(
+            q, tenant,
+            q.__dict__.get("_rver", getattr(self.g, "version", 0)),
+            text=text)
 
     def _plan_prepared(self, qq: SPARQLQuery, blind, plan_text,
                        tenant: str = "default") -> None:
@@ -684,6 +715,7 @@ class Proxy:
         self._observe_slo(ten, get_usec() - t0_us,
                           ok=status == ErrorCode.SUCCESS, status=status,
                           trace=trace)
+        self._observe_reuse(q, ten, text)
         return q
 
     # ------------------------------------------------------------------

@@ -368,6 +368,15 @@ class RecoveryManager:
         if self.stream is not None:
             self.stream.ingestor.stores = self._mutation_targets()
         self._replay_wal(after_seq, stats, trace)
+        # cache-coherence telemetry (obs/reuse.py): a restore force-bumps
+        # every partition's version and replaces array contents wholesale
+        # — a version-keyed result cache purges conservatively (the
+        # restored world's versions are not comparable to the cached
+        # keys'), and the edge lands as one cache.invalidate event
+        from wukong_tpu.obs.reuse import maybe_note_invalidation
+
+        maybe_note_invalidation("restore", version=None,
+                                checkpoint=stats["checkpoint"])
         if self.on_change is not None:
             self.on_change()
         log_info(f"recovery: checkpoint={stats['checkpoint']} "

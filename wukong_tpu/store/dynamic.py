@@ -239,6 +239,7 @@ def insert_batch_into(stores: list[GStore], triples: np.ndarray,
     replayable and a WAL failure leaves the stores untouched. The mutation
     lock keeps the append + fan-out atomic w.r.t. checkpoint
     serialization (runtime/recovery.py)."""
+    from wukong_tpu.obs.reuse import maybe_note_invalidation
     from wukong_tpu.store.wal import maybe_wal_append, mutation_lock
 
     with mutation_lock():
@@ -252,4 +253,12 @@ def insert_batch_into(stores: list[GStore], triples: np.ndarray,
         # and the sink is a transient mirror of a store already counted
         for g in migration_sinks():
             insert_triples(g, triples, dedup, check_ids=False)
-        return total
+    # cache-coherence telemetry (obs/reuse.py): the batch's version edge
+    # kills the stale shadow keys and lands one cache.invalidate event.
+    # Outside the mutation lock — the journal emit is pure observability
+    # and must never extend the write stall
+    if stores:
+        maybe_note_invalidation(
+            "insert", version=getattr(stores[0], "version", 0),
+            n_triples=int(len(triples)))
+    return total

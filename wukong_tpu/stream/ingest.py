@@ -257,6 +257,14 @@ class StreamIngestor:
                     with trace.span("stream.eval", epoch=self.epoch):
                         rec.eval_us = self.continuous.on_epoch(
                             self.epoch, triples, rec.ts)
+        # cache-coherence telemetry (obs/reuse.py): the epoch's version
+        # edge kills stale shadow keys + journals cache.invalidate —
+        # outside the mutation lock, pure observability
+        from wukong_tpu.obs.reuse import maybe_note_invalidation
+
+        maybe_note_invalidation("epoch", version=rec.version,
+                                epoch=rec.epoch,
+                                n_triples=rec.n_triples)
         if self.monitor is not None:
             self.monitor.record_stream_epoch(
                 n_triples=rec.n_triples, ingest_us=rec.ingest_us,
