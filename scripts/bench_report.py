@@ -70,6 +70,15 @@ def _headline(d: dict) -> dict | None:
     if isinstance(d.get("rebalance_gain"), (int, float)):
         return {"value": float(d["rebalance_gain"]), "unit": "x",
                 "metric": "rebalance_gain"}
+    # read-mostly CACHED serving drill: real result-cache q/s with the
+    # materialized-view plane armed (BENCH_READMOSTLY.json since PR 14;
+    # the drill self-gates on byte-identity, real >= shadow hit rate,
+    # >= 3x the PR 8 light-only baseline, and the flat write-rate
+    # curve). Checked before predicted_hit_rate: the artifact still
+    # carries the shadow ratio for the observe-only trend
+    if isinstance(d.get("readmostly_qps"), (int, float)):
+        return {"value": float(d["readmostly_qps"]), "unit": "q/s",
+                "metric": "readmostly_qps"}
     # read-mostly serving-cache drill: the achievable version-keyed
     # result-cache hit rate on the Zipfian mix (BENCH_READMOSTLY.json;
     # unit "ratio" is direction-less — the drill self-gates at >= 0.5

@@ -22,10 +22,13 @@ echo "== elastic rebalance drill (executed shard migration) =="
 # host imbalance under placement_imbalance_x (exits non-zero otherwise)
 JAX_PLATFORMS=cpu python bench.py --rebalance
 
-echo "== read-mostly serving-cache drill (shadow hit-rate acceptance) =="
-# the Zipfian read-mostly closed loop: predicted shadow-cache hit rate
-# >= 0.5 on the skewed mix, monotone degradation under write pressure,
-# store digest bit-untouched (exits non-zero otherwise)
+echo "== read-mostly serving drill (shadow + CACHED acceptance) =="
+# the Zipfian read-mostly closed loop, twice: observe-only (predicted
+# shadow hit rate >= 0.5, monotone degradation, store digest untouched)
+# then with the materialized-view serving plane armed (every reply
+# byte-identical to uncached execution, real hit rate >= shadow's,
+# >= 3x the PR 8 light-only q/s baseline, and the 8%-write hit rate
+# within 15 points of zero-write — exits non-zero otherwise)
 JAX_PLATFORMS=cpu python bench.py --readmostly
 
 echo "== bench trajectory check =="

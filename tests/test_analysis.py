@@ -586,3 +586,83 @@ def test_lockdep_wired_through_real_runtime(_lockdep_on):
     assert rep["enabled"] and rep["cycles"] == []
     assert any(e["from"] == "pool.route" and e["to"] == "pool.queue"
                for e in rep["edges"])
+
+
+# ---------------------------------------------------------------------------
+# cache-coherence gate: the serving-plane (actuator) half
+# ---------------------------------------------------------------------------
+
+_REUSE_OK = (
+    "CACHE_INPUTS = {'template_popularity': 'wukong_ok_total',"
+    " 'uncacheable': 'wukong_ok_total'}\n"
+    "INVALIDATION_CAUSES = ('insert', 'restore')\n"
+    "def reg(r):\n"
+    "    return r.counter('wukong_ok_total', 'h')\n")
+
+
+def test_cache_gate_serve_plane_fixtures(tmp_path):
+    """The actuator checks fire only on trees WITH serve/ files: consumed
+    inputs must be declared CACHE_INPUTS signals, MUTATION_EDGES must
+    equal INVALIDATION_CAUSES exactly, every cause must reach a
+    notify_mutation call site, and serve locks/state follow the reuse
+    module's leaf/annotation discipline."""
+    from wukong_tpu.analysis import run_analysis
+
+    bad = write_tree(tmp_path / "bad", {
+        "obs/reuse.py": _REUSE_OK,
+        "serve/result_cache.py": (
+            "CONSUMED_INPUTS = ('template_popularity', 'phantom_signal')\n"
+            "MUTATION_EDGES = {'insert': 'kill', 'ghost_edge': 'x'}\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self.entries = {}\n"
+            "        self.lock = make_lock('serve.x')\n"),
+        "store/dynamic.py": (
+            "def insert_batch(stores):\n"
+            "    maybe_note_invalidation('insert')\n"
+            "    notify_mutation('insert')\n"
+            "    notify_mutation('bogus_edge')\n")})
+    out = run_analysis(bad, plugins=["cache-coherence"])
+    msgs = "\n".join(str(v) for v in out)
+    assert "phantom_signal" in msgs      # consumed input not in CACHE_INPUTS
+    assert "'restore'" in msgs           # journaled cause missing from EDGES
+    assert "ghost_edge" in msgs          # phantom edge not a declared cause
+    assert "bogus_edge" in msgs          # undeclared cause at a notify site
+    assert "serve.x" in msgs             # undeclared leaf lock in serve/
+    assert "C.entries" in msgs           # unannotated shared serve state
+
+    good = write_tree(tmp_path / "good", {
+        "obs/reuse.py": _REUSE_OK + "declare_leaf('serve.x')\n",
+        "serve/result_cache.py": (
+            "CONSUMED_INPUTS = ('template_popularity', 'uncacheable')\n"
+            "MUTATION_EDGES = {'insert': 'kill stale', 'restore': 'purge'}\n"
+            "declare_leaf('serve.x')\n"
+            "class C:\n"
+            "    def __init__(self):\n"
+            "        self.entries = {}  # guarded by: lock\n"
+            "        self.lock = make_lock('serve.x')\n"),
+        "store/dynamic.py": (
+            "def insert_batch(stores):\n"
+            "    maybe_note_invalidation('insert')\n"
+            "    notify_mutation('insert')\n"),
+        "runtime/recovery.py": (
+            "def recover():\n"
+            "    maybe_note_invalidation('restore')\n"
+            "    notify_mutation('restore')\n")})
+    assert run_analysis(good, plugins=["cache-coherence"]) == []
+
+
+def test_cache_gate_observe_only_tree_skips_serve_checks(tmp_path):
+    """A tree WITHOUT serve/ (the PR 13 posture) is not required to have
+    an actuator: the notify_mutation coverage rule must not fire."""
+    from wukong_tpu.analysis import run_analysis
+
+    tree = write_tree(tmp_path / "obs", {
+        "obs/reuse.py": _REUSE_OK,
+        "store/dynamic.py": (
+            "def insert_batch(stores):\n"
+            "    maybe_note_invalidation('insert')\n"),
+        "runtime/recovery.py": (
+            "def recover():\n"
+            "    maybe_note_invalidation('restore')\n")})
+    assert run_analysis(tree, plugins=["cache-coherence"]) == []

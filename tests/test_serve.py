@@ -1,0 +1,643 @@
+"""Materialized-view serving plane (ISSUE 14): the version-keyed result
+cache and incrementally-maintained hot-template views.
+
+Acceptance surface: a cache hit rebuilds a byte-identical reply (table
+bytes, projection map, counts) and the zero-parse fast path serves
+repeated texts without touching the parser; admission follows the
+popularity ledger's verdicts read through ``CACHE_INPUTS``; concurrent
+misses on one key collapse onto a single execution; every journaled
+mutation edge reaches the actuator — insert/epoch edges kill
+stale-version entries (or re-key them when the view's semi-naive delta
+evaluation proves the template untouched), cutover/restore purge
+conservatively with served replies byte-identical throughout (the PR 12
+kill-and-resume posture); promotion honors the delta planner's
+rejection rules and the maintenance-cost demotion; real-vs-shadow
+divergence is counted; the ``/cache`` report, console verb, and Monitor
+line surface the real cache next to the shadow; and
+``Emulator.run_readmostly(cached=True, views=True)`` proves the
+end-to-end contract. The whole module runs fully lockdep-checked.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from wukong_tpu.config import Global
+from wukong_tpu.engine.cpu import CPUEngine
+from wukong_tpu.loader.lubm import UB, VirtualLubmStrings, generate_lubm
+from wukong_tpu.obs.events import get_journal
+from wukong_tpu.obs.reuse import (
+    CACHE_INPUTS,
+    INVALIDATION_CAUSES,
+    get_reuse,
+    render_cache,
+)
+from wukong_tpu.obs.tsdb import get_tsdb
+from wukong_tpu.runtime import faults
+from wukong_tpu.runtime.proxy import Proxy
+from wukong_tpu.serve import get_serve
+from wukong_tpu.serve.result_cache import (
+    CONSUMED_INPUTS,
+    MUTATION_EDGES,
+    divergence_total,
+)
+from wukong_tpu.store.dynamic import insert_batch_into
+from wukong_tpu.store.gstore import build_partition
+from wukong_tpu.store.persist import gstore_digest
+from wukong_tpu.types import OUT
+from wukong_tpu.utils.errors import ErrorCode
+
+pytestmark = pytest.mark.serve
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _lockdep_checked():
+    """The serve suite runs fully lockdep-checked: serve.cache is a
+    declared leaf (dict updates only), serve.views is an ordinary
+    tracked lock held across delta evaluation — any acquisition under
+    the leaf, or any cycle through the WAL mutation lock, fails the
+    module teardown."""
+    from wukong_tpu.analysis import lockdep
+
+    lockdep.install(True)
+    yield
+    try:
+        assert lockdep.cycles() == [], lockdep.cycles()
+        assert lockdep.leaf_violations() == [], lockdep.leaf_violations()
+    finally:
+        lockdep.install(False)
+
+
+@pytest.fixture(scope="module")
+def world():
+    triples, _ = generate_lubm(1, seed=42)
+    g = build_partition(triples, 0, 1)
+    ss = VirtualLubmStrings(1, seed=42)
+    return {"g": g, "ss": ss, "triples": triples}
+
+
+@pytest.fixture(scope="module")
+def proxy(world):
+    return Proxy(world["g"], world["ss"],
+                 CPUEngine(world["g"], world["ss"]))
+
+
+@pytest.fixture(scope="module")
+def texts(world):
+    g, ss = world["g"], world["ss"]
+    pid = ss.str2id(f"<{UB}advisor>")
+    anchors = np.asarray(g.get_index(pid, OUT))
+    return [f"SELECT ?s WHERE {{ ?s <{UB}advisor> "
+            f"{ss.id2str(int(a))} . }}" for a in anchors[:32]]
+
+
+@pytest.fixture(autouse=True)
+def _hygiene(world, monkeypatch):
+    """Cache armed, views DISARMED (each rung-ii test arms explicitly),
+    plane re-attached to the module world, every process-wide ring
+    clean, no fault plan leaking across tests."""
+    monkeypatch.setattr(Global, "enable_result_cache", True)
+    monkeypatch.setattr(Global, "enable_views", False)
+    monkeypatch.setattr(Global, "enable_reuse", True)
+    monkeypatch.setattr(Global, "reuse_sample_every", 1)
+    monkeypatch.setattr(Global, "result_cache_min_reads", 1)
+    monkeypatch.setattr(Global, "view_promote_edges", 1)
+    monkeypatch.setattr(Global, "enable_events", True)
+    monkeypatch.setattr(Global, "enable_tracing", False)
+    plane = get_serve()
+    plane.reset()
+    plane.views.attach(world["g"], world["ss"])
+    get_reuse().reset()
+    get_journal().clear()
+    get_tsdb().reset()
+    faults.clear()
+    yield
+    faults.clear()
+    plane.reset()
+    get_reuse().reset()
+
+
+def _unrelated(world, k: int = 4):
+    """k triples whose predicate is NOT advisor (edges that cannot touch
+    the advisor-template views)."""
+    ss = world["ss"]
+    pid = ss.str2id(f"<{UB}advisor>")
+    t = world["triples"]
+    return t[t[:, 1] != pid][:k]
+
+
+def _matching(world, text_anchor: int):
+    """One triple matching (·, advisor, anchor) — a duplicate edge that
+    adds a duplicate row to the template's uncached reply."""
+    ss = world["ss"]
+    pid = ss.str2id(f"<{UB}advisor>")
+    t = world["triples"]
+    g = world["g"]
+    anchors = np.asarray(g.get_index(pid, OUT))
+    c0 = int(anchors[text_anchor])
+    return t[(t[:, 1] == pid) & (t[:, 2] == c0)][:1]
+
+
+def _oracle(proxy, text):
+    """Uncached execution through the same parse/plan path."""
+    q = proxy._parse_text(text)
+    proxy._plan_prepared(q, True, None)
+    proxy.cpu.execute(q)
+    return q
+
+
+def _same_reply(qa, qb) -> bool:
+    ra, rb = qa.result, qb.result
+    return (ra.status_code == rb.status_code
+            and ra.nrows == rb.nrows and ra.col_num == rb.col_num
+            and ra.v2c_map == rb.v2c_map
+            and np.array_equal(np.asarray(ra.table), np.asarray(rb.table)))
+
+
+# ---------------------------------------------------------------------------
+# rung i: the result cache
+# ---------------------------------------------------------------------------
+
+def test_off_knob_is_byte_for_byte_inert(proxy, texts, monkeypatch):
+    monkeypatch.setattr(Global, "enable_result_cache", False)
+    rc = get_serve().cache
+    before = rc.stats()
+    q = proxy.serve_query(texts[0], blind=True)
+    assert q.result.status_code == ErrorCode.SUCCESS
+    after = rc.stats()
+    assert (after["hits"], after["misses"], after["fills"]) == \
+        (before["hits"], before["misses"], before["fills"])
+
+
+def test_hit_serves_identical_bytes_and_fast_path_skips_parse(
+        proxy, texts):
+    rc = get_serve().cache
+    q1 = proxy.serve_query(texts[0], blind=True)
+    assert q1.__dict__.get("_rc_probe") == "miss"
+    q2 = proxy.serve_query(texts[0], blind=True)
+    assert q2.__dict__.get("_rc_probe") == "hit"
+    assert _same_reply(q1, q2)
+    oq = _oracle(proxy, texts[0])
+    assert _same_reply(q2, oq)
+    st = rc.stats()
+    assert st["hits"] >= 1 and st["fills"] == 1 and st["entries"] == 1
+    # the fast path never parses: a poisoned parser goes unnoticed
+    def boom(text):
+        raise AssertionError("fast path touched the parser")
+
+    orig = proxy._parse_text
+    proxy._parse_text = boom
+    try:
+        q3 = proxy.serve_query(texts[0], blind=True)
+    finally:
+        proxy._parse_text = orig
+    assert q3.__dict__.get("_rc_probe") == "hit"
+    assert _same_reply(q1, q3)
+    # the cached table is write-protected: a consumer cannot corrupt it
+    with pytest.raises(ValueError):
+        q3.result.table[0, 0] = 7
+
+
+def test_cached_table_survives_consumer_with_projection(proxy, texts):
+    """Non-blind replies cache separately from blind ones (blind is part
+    of the key) and carry the projected table."""
+    qb = proxy.serve_query(texts[0], blind=True)
+    qn = proxy.serve_query(texts[0], blind=False)
+    assert qn.__dict__.get("_rc_probe") == "miss"  # different key
+    qn2 = proxy.serve_query(texts[0], blind=False)
+    assert qn2.__dict__.get("_rc_probe") == "hit"
+    assert _same_reply(qn, qn2)
+    assert qb.result.blind and not qn2.result.blind
+
+
+def test_modifier_shapes_are_refused(proxy, texts):
+    rc = get_serve().cache
+    t = texts[0] + " LIMIT 3"
+    r0 = rc.stats()["refused"]
+    proxy.serve_query(t, blind=True)
+    proxy.serve_query(t, blind=True)
+    st = rc.stats()
+    assert st["refused"] >= r0 + 2
+    assert st["entries"] == 0  # nothing cached for the LIMIT shape
+
+
+def test_partial_or_error_reply_is_never_filled(proxy, texts):
+    """A deadline-truncated or failed reply must not enter the cache
+    (the reply-side uncacheable classes)."""
+    from wukong_tpu.serve.result_cache import ResultCache
+
+    rc = ResultCache()
+    q = _oracle(proxy, texts[0])
+    q.result.complete = False
+    assert rc.fill(("sig:x", (1,), "", (-1,), True), 0, q) is False
+    q.result.complete = True
+    q.result.status_code = ErrorCode.QUERY_TIMEOUT
+    assert rc.fill(("sig:x", (1,), "", (-1,), True), 0, q) is False
+    assert rc.stats()["entries"] == 0 and rc.stats()["refused"] == 2
+
+
+def test_admission_reads_ledger_verdict(proxy, texts, monkeypatch):
+    """result_cache_min_reads gates fills on the popularity ledger's
+    arrival verdict, read through the CACHE_INPUTS map."""
+    monkeypatch.setattr(Global, "result_cache_min_reads", 3)
+    rc = get_serve().cache
+    proxy.serve_query(texts[0], blind=True)  # reads+1 = 1 < 3: refused
+    assert rc.stats()["fills"] == 0
+    proxy.serve_query(texts[0], blind=True)  # reads+1 = 2 < 3: refused
+    assert rc.stats()["fills"] == 0
+    proxy.serve_query(texts[0], blind=True)  # reads+1 = 3: admitted
+    assert rc.stats()["fills"] == 1
+    q = proxy.serve_query(texts[0], blind=True)
+    assert q.__dict__.get("_rc_probe") == "hit"
+
+
+def test_insert_edge_kills_plain_entries(proxy, world, texts):
+    rc = get_serve().cache
+    proxy.serve_query(texts[0], blind=True)
+    assert rc.stats()["entries"] == 1
+    insert_batch_into(proxy._insert_targets(), _unrelated(world),
+                      dedup=False)
+    st = rc.stats()
+    assert st["entries"] == 0 and st["killed"] >= 1  # no view: all die
+    q = proxy.serve_query(texts[0], blind=True)
+    assert q.__dict__.get("_rc_probe") == "miss"  # refilled at the new
+    assert _same_reply(q, _oracle(proxy, texts[0]))  # version, correct
+
+
+def test_request_collapsing_one_execution_many_waiters(proxy, texts):
+    rc = get_serve().cache
+    calls = []
+    orig = proxy.cpu.execute
+
+    def slow(q, **kw):
+        calls.append(1)
+        time.sleep(0.2)
+        return orig(q, **kw)
+
+    proxy.cpu.execute = slow
+    results = [None] * 4
+    try:
+        def worker(i):
+            results[i] = proxy.serve_query(texts[1], blind=True)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(4)]
+        threads[0].start()
+        for _ in range(200):  # wait for the leader to be in flight
+            if rc.stats()["inflight"]:
+                break
+            time.sleep(0.005)
+        for t in threads[1:]:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+    finally:
+        proxy.cpu.execute = orig
+    assert sum(calls) == 1  # ONE execution served every waiter
+    st = rc.stats()
+    assert st["collapsed"] == 3 and st["fills"] == 1
+    for r in results[1:]:
+        assert r is not None and _same_reply(results[0], r)
+
+
+# ---------------------------------------------------------------------------
+# rung ii: materialized views
+# ---------------------------------------------------------------------------
+
+def test_view_promotion_survival_and_touch(proxy, world, texts,
+                                           monkeypatch):
+    monkeypatch.setattr(Global, "enable_views", True)
+    rc, vr = get_serve().cache, get_serve().views
+    proxy.serve_query(texts[0], blind=True)  # fill v0
+    insert_batch_into(proxy._insert_targets(), _unrelated(world),
+                      dedup=False)  # edge 1: entry dies (no view yet)
+    proxy.serve_query(texts[0], blind=True)  # refill -> vote 1 -> promote
+    assert vr.count() == 1
+    insert_batch_into(proxy._insert_targets(), _unrelated(world),
+                      dedup=False)  # edge 2: delta eval proves untouched
+    assert rc.stats()["entries"] == 1  # the entry SURVIVED the write
+    q = proxy.serve_query(texts[0], blind=True)
+    assert q.__dict__.get("_rc_probe") == "hit"
+    assert _same_reply(q, _oracle(proxy, texts[0]))
+    # a matching duplicate edge derives a row -> touched -> refresh
+    nrows0 = q.result.nrows
+    insert_batch_into(proxy._insert_targets(), _matching(world, 0),
+                      dedup=False)
+    assert rc.stats()["entries"] == 0  # touched: the entry dropped
+    q2 = proxy.serve_query(texts[0], blind=True)
+    assert q2.__dict__.get("_rc_probe") == "miss"
+    assert q2.result.nrows == nrows0 + 1  # the duplicate row appears
+    assert _same_reply(q2, _oracle(proxy, texts[0]))
+    st = vr.stats()
+    assert st["views"][0]["survived"] >= 1
+    assert st["views"][0]["touched"] == 1
+
+
+def test_view_rejection_rules_ban_back_to_plain_entries(
+        proxy, world, texts, monkeypatch):
+    """A variable-predicate template is rung-i cacheable but has no
+    incremental semantics — registration rejects it and the template
+    stays a plain (version-keyed) cache entry."""
+    monkeypatch.setattr(Global, "enable_views", True)
+    ss = world["ss"]
+    pid = ss.str2id(f"<{UB}advisor>")
+    anchors = np.asarray(world["g"].get_index(pid, OUT))
+    t = f"SELECT ?s ?p WHERE {{ ?s ?p {ss.id2str(int(anchors[0]))} . }}"
+    vr = get_serve().views
+    proxy.serve_query(t, blind=True)  # fill
+    insert_batch_into(proxy._insert_targets(), _unrelated(world),
+                      dedup=False)
+    proxy.serve_query(t, blind=True)  # refill -> vote -> promotion try
+    assert vr.count() == 0
+    assert vr.stats()["rejected"] == 1 and vr.stats()["banned"] == 1
+    # still a working plain entry at the current version
+    q = proxy.serve_query(t, blind=True)
+    assert q.__dict__.get("_rc_probe") == "hit"
+
+
+def test_view_demoted_when_every_edge_touches_it(proxy, world, texts,
+                                                 monkeypatch):
+    monkeypatch.setattr(Global, "enable_views", True)
+    monkeypatch.setattr(Global, "view_demote_touch_pct", 60)
+    rc, vr = get_serve().cache, get_serve().views
+    proxy.serve_query(texts[2], blind=True)
+    insert_batch_into(proxy._insert_targets(), _unrelated(world),
+                      dedup=False)
+    proxy.serve_query(texts[2], blind=True)  # promote
+    assert vr.count() == 1
+    match = _matching(world, 2)
+    for _ in range(9):  # every edge derives a row: pure maintenance cost
+        insert_batch_into(proxy._insert_targets(), match, dedup=False)
+    assert vr.count() == 0
+    st = vr.stats()
+    assert st["demoted"] == 1 and st["banned"] >= 1
+    # demoted means plain entries again: correctness unchanged
+    q = proxy.serve_query(texts[2], blind=True)
+    assert _same_reply(q, _oracle(proxy, texts[2]))
+
+
+def test_stream_epoch_edge_maintains_views(proxy, world, texts,
+                                           monkeypatch):
+    monkeypatch.setattr(Global, "enable_views", True)
+    rc, vr = get_serve().cache, get_serve().views
+    proxy.serve_query(texts[3], blind=True)
+    proxy.stream_feed(_unrelated(world, 6))  # epoch edge 1
+    proxy.serve_query(texts[3], blind=True)  # refill -> promote
+    assert vr.count() == 1
+    proxy.stream_feed(_unrelated(world, 6))  # epoch edge 2: untouched
+    assert rc.stats()["entries"] >= 1
+    q = proxy.serve_query(texts[3], blind=True)
+    assert q.__dict__.get("_rc_probe") == "hit"
+    assert _same_reply(q, _oracle(proxy, texts[3]))
+    causes = {e.attrs["cause"]
+              for e in get_journal().last(kind="cache.invalidate")}
+    assert "epoch" in causes
+
+
+def test_lagged_entry_never_rekeys_past_an_unjudged_edge(
+        proxy, world, texts, monkeypatch):
+    """An entry whose fill raced an earlier edge (resident at an OLDER
+    version than the immediate pre-edge one) must DROP on the next edge
+    even when that edge's view verdict says survivor: survivorship
+    proves only the current batch changed nothing — an intermediate
+    touching edge was never judged against this entry."""
+    monkeypatch.setattr(Global, "enable_views", True)
+    rc = get_serve().cache
+    proxy.serve_query(texts[9], blind=True)
+    insert_batch_into(proxy._insert_targets(), _unrelated(world),
+                      dedup=False)
+    proxy.serve_query(texts[9], blind=True)  # promote + refill
+    assert get_serve().views.count() == 1
+    # simulate the racing fill: age the resident entry one extra version
+    # (as if it had been filled before an edge the view never judged)
+    with rc._lock:
+        (key, ent), = rc._entries.items()
+        ent.version -= 1
+    insert_batch_into(proxy._insert_targets(), _unrelated(world),
+                      dedup=False)  # survivor verdict, but entry lagged
+    assert rc.stats()["entries"] == 0  # dropped, not re-keyed
+    q = proxy.serve_query(texts[9], blind=True)
+    assert q.__dict__.get("_rc_probe") == "miss"
+    assert _same_reply(q, _oracle(proxy, texts[9]))
+
+
+# ---------------------------------------------------------------------------
+# chaos / recovery drills: cutover + restore purge, byte-identical serving
+# ---------------------------------------------------------------------------
+
+def _sstore(world, n_shards=4):
+    from wukong_tpu.parallel.sharded_store import ShardedDeviceStore
+
+    class _Mesh:
+        devices = np.empty(n_shards, dtype=object)
+
+    stores = [build_partition(world["triples"], i, n_shards)
+              for i in range(n_shards)]
+    return ShardedDeviceStore(stores, _Mesh(), replication_factor=1)
+
+
+def _mig_plan(donor=3, recipient=2):
+    from wukong_tpu.obs.placement import MigrationPlan
+    from wukong_tpu.utils.timer import get_usec
+
+    return MigrationPlan(
+        plan_id="mp-serve", t_us=get_usec(), donor_shard=donor,
+        recipient_host=recipient, predicted_move_bytes=1 << 20,
+        bytes_source="estimate", donor_rate_per_s=4.0,
+        mean_rate_per_s=1.0, imbalance_before=2.5, imbalance_after=1.5,
+        window_s=60.0, inputs={}, reason="serve-drill")
+
+
+def test_migration_cutover_purges_and_serving_stays_identical(
+        proxy, world, texts, monkeypatch):
+    from wukong_tpu.runtime.migration import get_migrator
+
+    rc = get_serve().cache
+    oracle0 = _oracle(proxy, texts[4])
+    q0 = proxy.serve_query(texts[4], blind=True)
+    proxy.serve_query(texts[4], blind=True)  # resident + hit
+    assert rc.stats()["entries"] == 1
+    sstore = _sstore(world)
+    mig = get_migrator()
+    mig.reset()
+    monkeypatch.setattr(Global, "migration_enable", True)
+    mig.attach(sstore=sstore, owner=None)
+    purges0 = rc.stats()["purges"]
+    job = mig.run_plan(_mig_plan())
+    assert job.phase == "done"
+    st = rc.stats()
+    assert st["purges"] == purges0 + 1 and st["entries"] == 0
+    # served replies byte-identical through the purge
+    q1 = proxy.serve_query(texts[4], blind=True)
+    assert q1.__dict__.get("_rc_probe") == "miss"
+    assert _same_reply(q1, q0) and _same_reply(q1, oracle0)
+    mig.reset()
+
+
+def test_migration_abort_rollback_also_purges(proxy, world, texts,
+                                              monkeypatch):
+    """The PR 12 kill-and-resume posture: a fault at the cutover aborts
+    with the donor untouched; the published-then-rolled-back read path
+    purges the cache on BOTH swaps, and serving stays byte-identical."""
+    from wukong_tpu.runtime.faults import FaultPlan, FaultSpec
+    from wukong_tpu.runtime.migration import get_migrator
+
+    rc = get_serve().cache
+    q0 = proxy.serve_query(texts[5], blind=True)
+    proxy.serve_query(texts[5], blind=True)
+    sstore = _sstore(world)
+    donor_digest = gstore_digest(sstore.stores[3])
+    mig = get_migrator()
+    mig.reset()
+    monkeypatch.setattr(Global, "migration_enable", True)
+    mig.attach(sstore=sstore, owner=None)
+    faults.install(FaultPlan(
+        [FaultSpec("migration.cutover", "shard_down")], seed=0))
+    with pytest.raises(Exception):
+        mig.run_plan(_mig_plan())
+    faults.clear()
+    assert mig.job().phase == "aborted"
+    assert gstore_digest(sstore.stores[3]) == donor_digest
+    q1 = proxy.serve_query(texts[5], blind=True)
+    assert _same_reply(q1, q0)
+    assert _same_reply(q1, _oracle(proxy, texts[5]))
+    mig.reset()
+
+
+def test_recovery_restore_purges_and_rebuilds(world, texts, tmp_path,
+                                              monkeypatch):
+    """Cache + views under RecoveryManager restore: conservative purge
+    (cause ``restore``), then refills byte-identical to the restored
+    world's uncached execution."""
+    monkeypatch.setattr(Global, "enable_views", True)
+    monkeypatch.setattr(Global, "wal_dir", str(tmp_path / "wal"))
+    monkeypatch.setattr(Global, "checkpoint_dir", str(tmp_path / "ckpt"))
+    from wukong_tpu.store.wal import reset_wal
+
+    reset_wal()
+    g = build_partition(world["triples"], 0, 1)
+    ss = world["ss"]
+    p = Proxy(g, ss, CPUEngine(g, ss))  # attach binds the plane to g
+    rc = get_serve().cache
+    pid = ss.str2id(f"<{UB}advisor>")
+    anchors = np.asarray(g.get_index(pid, OUT))
+    t = (f"SELECT ?s WHERE {{ ?s <{UB}advisor> "
+         f"{ss.id2str(int(anchors[0]))} . }}")
+    p.serve_query(t, blind=True)
+    p.recovery().checkpoint()
+    insert_batch_into(p._insert_targets(), _unrelated(world),
+                      dedup=False)
+    q_pre = p.serve_query(t, blind=True)  # refill at the new version
+    assert rc.stats()["entries"] == 1
+    purges0 = rc.stats()["purges"]
+    p.recover()
+    st = rc.stats()
+    assert st["purges"] == purges0 + 1 and st["entries"] == 0
+    causes = {e.attrs["cause"]
+              for e in get_journal().last(kind="cache.invalidate")}
+    assert "restore" in causes
+    # post-restore: WAL replayed the insert, so the refilled reply is
+    # byte-identical to BOTH the pre-restore reply and a fresh oracle
+    q_post = p.serve_query(t, blind=True)
+    assert q_post.__dict__.get("_rc_probe") == "miss"
+    assert _same_reply(q_post, q_pre)
+    assert _same_reply(q_post, _oracle(p, t))
+    reset_wal()
+
+
+# ---------------------------------------------------------------------------
+# observability surfaces + contracts
+# ---------------------------------------------------------------------------
+
+def test_divergence_counter_fires_on_disagreement(proxy, texts,
+                                                  monkeypatch):
+    """Shrink the shadow ring to 1 key: the real cache keeps hitting
+    where the shadow keeps missing — every disagreement on the same
+    probe counts."""
+    from wukong_tpu.obs.reuse import ReuseObservatory
+    import wukong_tpu.obs.reuse as reuse_mod
+
+    obs = ReuseObservatory(capacity=1)
+    monkeypatch.setattr(reuse_mod, "_observatory", obs)
+    d0 = divergence_total()
+    for _ in range(3):
+        proxy.serve_query(texts[6], blind=True)
+        proxy.serve_query(texts[7], blind=True)
+    assert divergence_total() > d0
+
+
+def test_cache_report_and_monitor_surface_the_real_cache(proxy, texts):
+    proxy.serve_query(texts[8], blind=True)
+    proxy.serve_query(texts[8], blind=True)
+    text, js = render_cache(4)
+    assert "REAL" in text and "views" in text
+    assert js["real"]["enabled"] is True
+    assert js["real"]["cache"]["hits"] >= 1
+    assert "divergence" in js["real"]
+    lines = proxy.monitor.cache_lines()
+    assert any("Cache[real" in ln for ln in lines)
+    assert any("Cache[shadow" in ln for ln in lines)
+
+
+def test_consumer_contracts_are_literal_and_closed():
+    """Runtime mirror of the cache-coherence gate's serve-plane half."""
+    assert set(MUTATION_EDGES) == set(INVALIDATION_CAUSES)
+    assert set(CONSUMED_INPUTS) <= set(CACHE_INPUTS)
+
+
+def test_read_cache_input_rejects_undeclared_signals():
+    from wukong_tpu.obs.reuse import read_cache_input
+
+    with pytest.raises(KeyError):
+        read_cache_input("not_a_signal")
+    v = read_cache_input("template_popularity", template="sig:zzzz")
+    assert v == {"reads": 0, "rate_qps": 0.0, "cacheable": True}
+
+
+def test_serve_gate_holds_on_the_live_tree():
+    import os
+
+    from wukong_tpu.analysis import run_analysis
+
+    pkg = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "wukong_tpu")
+    assert run_analysis(pkg, plugins=["cache-coherence"]) == []
+
+
+# ---------------------------------------------------------------------------
+# the acceptance fixture, small: cached read-mostly end to end
+# ---------------------------------------------------------------------------
+
+def test_run_readmostly_cached_acceptance(world, monkeypatch):
+    from wukong_tpu.runtime.emulator import Emulator
+
+    monkeypatch.setattr(Global, "views_max", 128)
+    # a PRIVATE world: the write phases mutate the store
+    g = build_partition(world["triples"], 0, 1)
+    ss = world["ss"]
+    p = Proxy(g, ss, CPUEngine(g, ss))
+    pid = ss.str2id(f"<{UB}advisor>")
+    anchors = np.asarray(g.get_index(pid, OUT))
+    texts = [f"SELECT ?s WHERE {{ ?s <{UB}advisor> "
+             f"{ss.id2str(int(a))} . }}" for a in anchors[:48]]
+    emu = Emulator(p)
+    rep = emu.run_readmostly(
+        texts, reads=120, warmup_reads=60, write_rates=(0.0, 0.1),
+        zipf_a=1.3, seed=3, write_batch=world["triples"][:512],
+        batch_rows=16, tenants=["gold", "bulk"],
+        cached=True, views=True)
+    real = rep["real"]
+    assert real["identical"] is True and real["mismatches"] == 0
+    assert real["hit_rate"] is not None
+    assert real["beats_shadow"] is True
+    assert rep["store_untouched"] is True
+    # rung ii flattened the write-phase collapse: the 10%-write real hit
+    # rate stays far above the shadow's version-keyed prediction
+    wp = next(p_ for p_ in rep["phases"] if p_["write_rate"] > 0)
+    assert wp["real_hit_rate"] is not None
+    assert wp["real_hit_rate"] >= wp["hit_rate"]
+    assert real["views"]["registered"] > 0
+    # the knobs were restored by the drill
+    assert Global.enable_result_cache is True  # the hygiene fixture's

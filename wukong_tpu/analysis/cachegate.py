@@ -28,6 +28,25 @@ the heat-/slo-/placement-telemetry pattern applied to the cache plane:
   every lockdep factory lock the module creates is declared a leaf there
   (ledger/shadow counters are innermost by construction — probes fire
   from the proxy reply path).
+
+The ACTUATOR half (``wukong_tpu/serve/`` — the materialized-view serving
+plane, checked only when the tree has serve/ files):
+
+- ``serve/result_cache.py`` must declare a literal ``CONSUMED_INPUTS``
+  tuple, every element a ``CACHE_INPUTS`` key — the cache's admission
+  reads are the PLACEMENT_INPUTS consumer contract, held literal.
+- its literal ``MUTATION_EDGES`` dict's keys must equal
+  ``INVALIDATION_CAUSES`` exactly: a mutation class the observatory
+  journals but the actuator ignores would serve stale bytes silently,
+  and a declared edge with no journaled cause is a phantom consumer.
+- every declared cause must reach the actuator through >=1
+  ``notify_mutation`` call site (with a declared cause literal), so the
+  real cache hears every edge the shadow cache hears.
+- serve/ ``__init__`` shared state is annotated like reuse.py's, and
+  every lockdep lock ``serve/result_cache.py`` creates is declared a
+  leaf there (the cache lock guards dict updates only; the view
+  registry's lock is deliberately NOT a leaf — it is held across
+  delta evaluation).
 """
 
 from __future__ import annotations
@@ -49,6 +68,9 @@ from wukong_tpu.analysis.telemetry import (
 REUSE_MODULE = "obs/reuse.py"
 INPUTS_NAME = "CACHE_INPUTS"
 CAUSES_NAME = "INVALIDATION_CAUSES"
+SERVE_CACHE_MODULE = "serve/result_cache.py"
+CONSUMED_NAME = "CONSUMED_INPUTS"
+EDGES_NAME = "MUTATION_EDGES"
 #: tsdb query methods whose metric-name argument is a cache-plane READ
 TSDB_READS = ("rate", "rate_by_label", "series", "quantile", "latest")
 
@@ -199,10 +221,12 @@ class CacheCoherenceGate(AnalysisPlugin):
                         "number"))
             out.extend(self._check_trend_reads(sf, set(inputs.values())))
 
-        out.extend(self._check_causes(ctx, sf))
+        causes, causes_line = self._literal_tuple(sf, CAUSES_NAME)
+        out.extend(self._check_causes(ctx, causes, causes_line))
         out.extend(self._check_mutation_paths(ctx))
         out.extend(self._check_init_annotations(sf))
         out.extend(self._check_leaf_locks(sf))
+        out.extend(self._check_serve_plane(ctx, inputs, causes))
         return out
 
     # ------------------------------------------------------------------
@@ -231,10 +255,10 @@ class CacheCoherenceGate(AnalysisPlugin):
                     "declared centrally"))
         return out
 
-    def _check_causes(self, ctx: RepoContext, sf) -> list[Violation]:
+    def _check_causes(self, ctx: RepoContext, causes,
+                      line: int) -> list[Violation]:
         """INVALIDATION_CAUSES is a closed set: literal causes at call
         sites must be declared, declared causes must be used."""
-        causes, line = self._literal_tuple(sf, CAUSES_NAME)
         if causes is None:
             return [Violation(
                 self.name, REUSE_MODULE, line or 1,
@@ -351,7 +375,103 @@ class CacheCoherenceGate(AnalysisPlugin):
                 declared.add(s)
         return [Violation(
             self.name, sf.rel, line,
-            f"reuse lock {name!r} is not declared a lockdep leaf in "
-            f"{sf.rel} — ledger/shadow counters must be innermost "
-            "(declare_leaf) so lockdep flags any acquisition under them")
+            f"cache-plane lock {name!r} is not declared a lockdep leaf "
+            f"in {sf.rel} — ledger/shadow/result-cache counters must be "
+            "innermost (declare_leaf) so lockdep flags any acquisition "
+            "under them")
             for name, line in sorted(made.items()) if name not in declared]
+
+    # ------------------------------------------------------------------
+    # the actuator half: the serving plane (wukong_tpu/serve/)
+    # ------------------------------------------------------------------
+    def _check_serve_plane(self, ctx: RepoContext, inputs,
+                           causes) -> list[Violation]:
+        serve_files = [p for p in ctx.paths() if p.startswith("serve/")]
+        if not serve_files:
+            return []  # observe-only tree: no actuator to check
+        out: list[Violation] = []
+        if SERVE_CACHE_MODULE not in ctx.paths():
+            return [Violation(
+                self.name, serve_files[0], 1,
+                f"serve/ exists but {SERVE_CACHE_MODULE} does not — the "
+                "serving plane's consumer contract (CONSUMED_INPUTS + "
+                "MUTATION_EDGES) has no home")]
+        sf = ctx.file(SERVE_CACHE_MODULE)
+
+        consumed, line = self._literal_tuple(sf, CONSUMED_NAME)
+        if consumed is None:
+            out.append(Violation(
+                self.name, SERVE_CACHE_MODULE, line or 1,
+                f"no literal {CONSUMED_NAME} tuple found — declare every "
+                "observatory signal the cache's admission reads"))
+        elif inputs is not None:
+            for signal in consumed:
+                if signal not in inputs:
+                    out.append(Violation(
+                        self.name, SERVE_CACHE_MODULE, line,
+                        f"consumed input {signal!r} is not a declared "
+                        f"{INPUTS_NAME} signal — the actuator reads a "
+                        "number the observatory never promised"))
+
+        edges, eline = self._literal_dict(sf, EDGES_NAME)
+        if edges is None:
+            out.append(Violation(
+                self.name, SERVE_CACHE_MODULE, eline or 1,
+                f"no literal {EDGES_NAME} dict found — declare what the "
+                "serving plane does on each journaled mutation edge"))
+        elif causes is not None:
+            for c in sorted(set(causes) - set(edges)):
+                out.append(Violation(
+                    self.name, SERVE_CACHE_MODULE, eline,
+                    f"mutation cause {c!r} is journaled by the "
+                    f"observatory but missing from {EDGES_NAME} — the "
+                    "actuator would serve stale bytes through that edge"))
+            for c in sorted(set(edges) - set(causes)):
+                out.append(Violation(
+                    self.name, SERVE_CACHE_MODULE, eline,
+                    f"{EDGES_NAME} declares edge {c!r} which is not an "
+                    f"{CAUSES_NAME} member (phantom consumer)"))
+
+        out.extend(self._check_notify_sites(ctx, causes))
+        for rel in serve_files:
+            mod = ctx.file(rel)
+            out.extend(self._check_init_annotations(mod))
+        out.extend(self._check_leaf_locks(sf))
+        return out
+
+    def _check_notify_sites(self, ctx: RepoContext,
+                            causes) -> list[Violation]:
+        """Every notify_mutation call site uses a declared cause, and
+        every declared cause reaches the actuator through >=1 site."""
+        if causes is None:
+            return []
+        out: list[Violation] = []
+        used: set[str] = set()
+        for mod in ctx.iter_files():
+            if mod.tree is None:
+                continue
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Call) or not node.args:
+                    continue
+                fname = node.func.attr if isinstance(
+                    node.func, ast.Attribute) else (
+                    node.func.id if isinstance(node.func, ast.Name)
+                    else "")
+                if fname != "notify_mutation":
+                    continue
+                s = _str_const(node.args[0])
+                if s is None:
+                    continue
+                used.add(s)
+                if s not in causes:
+                    out.append(Violation(
+                        self.name, mod.rel, node.lineno,
+                        f"serving-plane mutation edge {s!r} is not "
+                        f"declared in {REUSE_MODULE}::{CAUSES_NAME}"))
+        for c in sorted(set(causes) - used):
+            out.append(Violation(
+                self.name, SERVE_CACHE_MODULE, 1,
+                f"declared invalidation cause {c!r} never reaches the "
+                "serving plane (no notify_mutation call site) — the "
+                "real cache would miss an edge the shadow cache hears"))
+        return out

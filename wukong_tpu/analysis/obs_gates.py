@@ -48,6 +48,10 @@ EXECUTE_ALLOWLIST = {
     ("batcher.py", "_run_slice"),     # the heavy lane's sliced dispatch
     ("emulator.py", "run"),           # device-class precompile warmup
     ("emulator.py", "_device_batch"),  # compiled-batch emulator flights
+    # the cached read-mostly drill's byte-identity oracle MUST bypass
+    # the serving path (and its result cache) — comparing the cache
+    # against itself would prove nothing
+    ("emulator.py", "_readmostly_oracle"),
 }
 
 #: engine attrs the batcher-route gate treats as dispatch entry points

@@ -283,6 +283,36 @@ class GlobalConfig:
     # the serving micro — the ledger charge always runs)
     reuse_sample_every: int = 1
 
+    # ---- materialized-view serving plane (wukong_tpu/serve/; all
+    # mutable) ----
+    # the REAL version-keyed full-result cache in the proxy reply path
+    # (ROADMAP item 7 rung i). OFF by default: the serving path is
+    # byte-for-byte unchanged (the migration_enable actuator posture).
+    # On, it requires enable_reuse for its admission substrate — with
+    # the observatory off the cache admits nothing.
+    enable_result_cache: bool = False
+    # bound on result bytes held (LRU-evicted past it; one entry may
+    # never exceed a quarter of the budget)
+    result_cache_mb: int = 64
+    # popularity admission: a reply is cached only once its template has
+    # this many ledger reads, counting the reply itself (1 = the second
+    # serve of a template hits — shadow-cache parity; raise to reserve
+    # the byte budget for genuinely recurring templates)
+    result_cache_min_reads: int = 1
+    # rung ii: promote templates that stay hot across version edges into
+    # incrementally-maintained views (semi-naive delta eval per mutation
+    # edge re-keys untouched entries, so hits survive writes). Off, the
+    # cache keeps the pure rung-i posture: every write kills every key.
+    enable_views: bool = False
+    # version-edge misses a template must accumulate before promotion
+    view_promote_edges: int = 2
+    # demote a view touched on more than this percent of its observed
+    # edges (>=8 edges seen): maintenance that never saves a hit is
+    # rolled back to plain cache entries
+    view_demote_touch_pct: int = 60
+    # bound on concurrently maintained views
+    views_max: int = 64
+
     # ---- concurrency checking (wukong_tpu/analysis/lockdep.py) ----
     # lockdep-style runtime lock-order checker: locks created through the
     # analysis.lockdep factories become Debug wrappers that record the

@@ -131,6 +131,11 @@ _M_STALE = get_registry().histogram(
     "Seconds between consecutive store-version invalidation edges",
     buckets=(0.01, 0.1, 1, 5, 15, 60, 300, 1800, 7200))
 
+# pre-resolved shadow-outcome children: the probe pays labels()'s kwargs
+# hash per reply otherwise (the serve plane's hot-path discipline)
+_C_SHADOW_HIT = _M_SHADOW.labels(result="hit")
+_C_SHADOW_MISS = _M_SHADOW.labels(result="miss")
+
 
 # signature -> digest memo: repr+crc32 per reply was the observe hook's
 # single biggest cost on the serving micro; distinct signatures are
@@ -268,6 +273,32 @@ class TemplatePopularityLedger:
             if st is not None:
                 st.uncacheable[reason] = st.uncacheable.get(reason, 0) + 1
 
+    def verdict(self, template: str) -> dict:
+        """One template's admission verdict (the serving cache's read,
+        via :func:`read_cache_input`): reads, windowed arrival rate, and
+        whether any reply was ever uncacheable. ONE lock acquisition."""
+        with self._lock:
+            st = self._templates.get(template)
+            if st is None:
+                return {"reads": 0, "rate_qps": 0.0, "cacheable": True}
+            reads = st.reads
+            arrivals = list(st.arrivals_us)
+            unc = sum(st.uncacheable.values())
+        rate = 0.0
+        if len(arrivals) >= 2:
+            span = (arrivals[-1] - arrivals[0]) / 1e6
+            if span > 0:
+                rate = (len(arrivals) - 1) / span
+        return {"reads": reads, "rate_qps": round(rate, 2),
+                "cacheable": unc == 0}
+
+    def uncacheable_counts(self, template: str) -> dict:
+        """One template's uncacheable-reply tally by reason (the serving
+        cache's second admission read)."""
+        with self._lock:
+            st = self._templates.get(template)
+            return dict(st.uncacheable) if st is not None else {}
+
     # ------------------------------------------------------------------
     def zipf_alpha(self) -> float:
         """Least-squares slope of log(reads) vs log(rank) over the
@@ -395,10 +426,10 @@ class ShadowCache:
                     evicted += 1
                 self.evicts += evicted
         if ent is not None:
-            _M_SHADOW.labels(result="hit").inc()
+            _C_SHADOW_HIT.inc()
             _M_SAVED.inc(saved)
             return True
-        _M_SHADOW.labels(result="miss").inc()
+        _C_SHADOW_MISS.inc()
         if evicted:
             _M_SHADOW.labels(result="evict").inc(evicted)
         return False
@@ -487,13 +518,20 @@ class ReuseObservatory:
 
     # ------------------------------------------------------------------
     def observe(self, q, tenant: str, version: int,
-                text: str = "") -> None:
+                text: str = "") -> bool | None:
         """Fold one served reply into the observatory. ``version`` is the
         store version the read executed against (the host partition's —
-        the same version the plan cache keys on)."""
+        the same version the plan cache keys on). Returns the shadow
+        probe's verdict (True = would have hit) or None when the reply
+        was uncacheable / the probe was sampled out — the real cache's
+        divergence counter compares against exactly this value."""
         from wukong_tpu.utils.errors import ErrorCode
 
-        key, reason = classify(q)
+        # the serving plane's probe (serve/result_cache.py) stashes its
+        # classification verdict on the query — one classify per reply,
+        # and the fast-path reply shell (no patterns) stays classifiable
+        ck = q.__dict__.get("_ckey")
+        key, reason = ck if ck is not None else classify(q)
         if key is not None:
             tkey = key[0]  # the signature digest
         else:
@@ -509,14 +547,14 @@ class ReuseObservatory:
         if reason is not None:
             _M_UNCACHEABLE.labels(reason=reason).inc()
             self.ledger.note_uncacheable(tkey, reason)
-            return
+            return None
         every = max(int(Global.reuse_sample_every), 1)
         if every > 1:
             self._probe_seq += 1
             if self._probe_seq % every:
-                return
-        self.shadow.probe(key, version, int(q.result.nrows),
-                          _payload_estimate(q))
+                return None
+        return self.shadow.probe(key, version, int(q.result.nrows),
+                                 _payload_estimate(q))
 
     # ------------------------------------------------------------------
     def report(self, k: int | None = None) -> dict:
@@ -557,12 +595,38 @@ def get_reuse() -> ReuseObservatory:
     return _observatory
 
 
-def maybe_observe_reuse(q, tenant: str, version: int, text: str = "") -> None:
+def maybe_observe_reuse(q, tenant: str, version: int,
+                        text: str = "") -> bool | None:
     """The proxy's reply hook: one knob check when the observatory is
-    off."""
+    off. Returns the shadow probe's verdict (None when off / not
+    probed) for the real cache's divergence comparison."""
     if not Global.enable_reuse:
-        return
-    _observatory.observe(q, tenant, version, text=text)
+        return None
+    return _observatory.observe(q, tenant, version, text=text)
+
+
+def read_cache_input(signal: str, template: str | None = None):
+    """The serving plane's ONLY read path into the observatory: every
+    number a caching decision consumes is read here by its
+    ``CACHE_INPUTS`` name, so the map stays the literal truth about what
+    the actuator depends on (the ``PLACEMENT_INPUTS`` /
+    ``ADMISSION_INPUTS`` consumer contract — serve/result_cache.py
+    declares its reads in ``CONSUMED_INPUTS``, gate-checked against this
+    map)."""
+    if signal not in CACHE_INPUTS:
+        raise KeyError(f"{signal!r} is not a declared cache input "
+                       f"(see {sorted(CACHE_INPUTS)})")
+    if signal == "template_popularity":
+        return _observatory.ledger.verdict(template or "")
+    if signal == "uncacheable":
+        return _observatory.ledger.uncacheable_counts(template or "")
+    if signal == "predicted_hit_rate":
+        return _observatory.shadow.hit_rate()
+    if signal == "zipf_skew":
+        return _observatory.ledger.zipf_alpha()
+    raise KeyError(f"cache input {signal!r} has no live read path here "
+                   "— scrape its backing metric "
+                   f"{CACHE_INPUTS[signal]!r} instead")
 
 
 def maybe_note_invalidation(cause: str, version: int | None = None,
@@ -646,19 +710,59 @@ def cache_hit_rates() -> dict:
 # the /cache report (endpoint + console verb + Monitor line)
 # ---------------------------------------------------------------------------
 
+def _real_cache_report() -> dict:
+    """The serving plane's live state (serve/): the real cache's stats,
+    the view registry, and the real-vs-shadow divergence tally."""
+    from wukong_tpu.serve import get_serve
+    from wukong_tpu.serve.result_cache import divergence_total
+
+    plane = get_serve()
+    return {"enabled": bool(Global.enable_result_cache),
+            "views_enabled": bool(Global.enable_views),
+            "cache": plane.cache.stats(),
+            "views": plane.views.stats(),
+            "divergence": divergence_total()}
+
+
 def render_cache(k: int | None = None) -> tuple[str, dict]:
     """(plain-text table, JSON dict) for the /cache endpoint and the
-    ``cache`` console verb: shadow-cache economics on top, the template
+    ``cache`` console verb: the REAL result cache + view registry on
+    top (serve/), the shadow-cache economics under it, the template
     popularity ranking below, parse/plan cache hit rates and the trend
     window at the bottom."""
     rep = _observatory.report(k)
     rates = cache_hit_rates()
     trend = reuse_trend()
-    js = {**rep, "caches": rates, "trend": trend}
+    real = _real_cache_report()
+    js = {**rep, "caches": rates, "trend": trend, "real": real}
     pop = rep["popularity"]
     sh = rep["shadow"]
 
-    lines = ["wukong-cache  (serving-cache observatory — observe-only)", ""]
+    lines = ["wukong-cache  (materialized-view serving plane + "
+             "observatory)", ""]
+    rc = real["cache"]
+    rhr = rc["hit_rate"]
+    if real["enabled"]:
+        lines.append(
+            f"REAL    hit_rate {'-' if rhr is None else format(rhr, '.1%')}  "
+            f"entries {rc['entries']}  "
+            f"held {rc['bytes_held']:,}/{rc['capacity_bytes']:,}B  "
+            f"hits {rc['hits']:,}  misses {rc['misses']:,}  "
+            f"collapsed {rc['collapsed']:,}  killed {rc['killed']:,}  "
+            f"views {real['views']['registered']}"
+            f"/{real['views']['capacity']}  "
+            f"diverged {real['divergence']:,}")
+        vs = real["views"]
+        if vs["promoted"] or vs["rejected"] or vs["demoted"]:
+            lines.append(
+                f"VIEWS   promoted {vs['promoted']}  rejected "
+                f"{vs['rejected']}  demoted {vs['demoted']}  "
+                + "  ".join(
+                    f"{v['template']}:{v['survived']}/{v['edges']}ok"
+                    for v in vs["views"][:4]))
+    else:
+        lines.append("REAL    (enable_result_cache is OFF — the "
+                     "observatory below is observe-only)")
     hr = sh["hit_rate"]
     lines.append(
         f"SHADOW  hit_rate {'-' if hr is None else format(hr, '.1%')}  "

@@ -54,7 +54,8 @@ events [-k <n>] [-s <shard>] [-K <kind>] [-j]
                              cluster event journal: breaker trips,
                              failovers, heals, WAL/checkpoint lifecycle,
                              SLO burns (also GET /events)
-cache [-k <n>] [-j]          serving-cache observatory: shadow hit rate,
+cache [-k <n>] [-j]          serving plane + observatory: real result-
+                             cache hit rate/bytes/views, shadow hit rate,
                              template popularity + cacheability verdicts,
                              invalidation trend (also GET /cache)
 plan [-j] [-n]               observe-only placement advisor: run one
@@ -398,7 +399,7 @@ class Console:
                                                 kind=ns.K))
 
     def _cache(self, rest) -> None:
-        """cache: the serving-cache observatory (the /cache body)."""
+        """cache: the serving plane + observatory (the /cache body)."""
         from wukong_tpu.obs.reuse import render_cache
 
         ap = argparse.ArgumentParser(prog="cache")

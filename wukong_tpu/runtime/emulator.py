@@ -91,6 +91,20 @@ def _probe_read(g):
             else np.empty(0, np.int64))
 
 
+def _replies_identical(qa, qb) -> bool:
+    """Byte-level reply equality for the cached read-mostly drill: a
+    cache-served reply must be indistinguishable from the uncached
+    execution — status, row/column counts, the table's bytes, and the
+    projection map all compare."""
+    ra, rb = qa.result, qb.result
+    return (ra.status_code == rb.status_code
+            and bool(ra.complete) == bool(rb.complete)
+            and int(ra.nrows) == int(rb.nrows)
+            and int(ra.col_num) == int(rb.col_num)
+            and ra.v2c_map == rb.v2c_map
+            and np.array_equal(np.asarray(ra.table), np.asarray(rb.table)))
+
+
 def _zipf_drive(sstore, hot: int, n_ops: int, zipf_a: float, rng,
                 what: str) -> None:
     """Drive ``n_ops`` probe fetches whose shard choice follows a
@@ -735,7 +749,8 @@ class Emulator:
                        write_rates=(0.0, 0.02, 0.08),
                        zipf_a: float = 1.1, seed: int = 0,
                        write_batch=None, batch_rows: int = 48,
-                       tenants: list | None = None) -> dict:
+                       tenants: list | None = None,
+                       cached: bool = False, views: bool = False) -> dict:
         """The Zipfian read-mostly closed loop: template+const reads drawn
         Zipf(``zipf_a``) over ``texts`` through the REAL serving entry
         (``serve_query``), replayed once per ``write_rates`` phase with
@@ -763,6 +778,23 @@ class Emulator:
         batch is a real version edge); phases with a positive write rate
         require it. ``tenants`` rotates reply attribution across the
         given tenant names (default single-tenant).
+
+        ``cached=True`` flips the drill from observe-only to the
+        ACTUATOR (wukong_tpu/serve/): the real result cache fronts every
+        serve, and every reply is compared byte-for-byte against an
+        uncached oracle execution of the same text (status, rows,
+        columns, table bytes, projection map) — one mismatch fails the
+        ``identical`` verdict. Write phases verify inline, each reply
+        against the store state it saw; pure-read phases verify in a
+        sweep AFTER the timed window (one oracle per distinct text
+        served — re-serving returns the same resident entry, so the
+        comparison witnesses exactly the measured bytes without the
+        oracle's executions polluting the throughput number).
+        ``views=True`` additionally arms rung ii, so hot templates
+        promote to materialized views and their hit rates survive the
+        write phases. Cached q/s is measured over the cached serves
+        alone; ``uncached_qps`` reports the oracle's rate for the
+        in-run speedup.
         """
         from wukong_tpu.obs.reuse import get_reuse, reuse_trend
         from wukong_tpu.obs.tsdb import get_tsdb
@@ -784,65 +816,148 @@ class Emulator:
         tens = tenants or ["default"]
         g = self.proxy.g
 
-        def serve_one(k: int) -> bool:
+        rc = vr = None
+        knobs0 = (Global.enable_result_cache, Global.enable_views)
+        if cached:
+            from wukong_tpu.serve import get_serve
+
+            plane = get_serve()
+            plane.reset()
+            plane.attach(g, self.proxy.str_server)
+            Global.enable_result_cache = True
+            Global.enable_views = bool(views)
+            rc = plane.cache
+            vr = plane.views
+        cached_us = [0]
+        oracle_us = [0]
+        oracle_n = [0]
+        mismatches = [0]
+        deferred: list = []  # zero-write phases: texts to verify after
+
+        def serve_one(k: int, measured: bool = True,
+                      verify_inline: bool = True) -> bool:
             text = texts[int(rng.choice(n, p=w))]
             try:
+                t0 = get_usec()
                 q = self.proxy.serve_query(text, blind=True,
                                            tenant=tens[k % len(tens)])
-                return q.result.status_code == ErrorCode.SUCCESS
+                cached_us[0] += get_usec() - t0
+                ok = q.result.status_code == ErrorCode.SUCCESS
             except Exception:
                 return False
-
-        phases = []
-        store_untouched = None
-        for write_rate in write_rates:
-            every = int(round(1.0 / write_rate)) if write_rate > 0 else 0
-            if write_rate == 0 and store_untouched is None:
-                # the observe-only proof brackets THIS phase (warmup +
-                # measurement are both pure reads), wherever it sits in
-                # the write_rates ordering
-                digest0 = gstore_digest(g)
-                version0 = int(getattr(g, "version", 0))
-            # warm the shadow population for THIS phase's steady state
-            # (uncounted — the hit rate models a long-running cache, not
-            # its cold start)
-            for k in range(warmup_reads):
-                serve_one(k)
-            s0 = obs.shadow.stats()
-            served = errors = writes = 0
-            t0 = get_usec()
-            for k in range(reads):
-                if serve_one(k):
-                    served += 1
+            if cached and measured:
+                if verify_inline:
+                    t1 = get_usec()
+                    oq = self._readmostly_oracle(text)
+                    oracle_us[0] += get_usec() - t1
+                    oracle_n[0] += 1
+                    if not _replies_identical(q, oq):
+                        mismatches[0] += 1
                 else:
-                    errors += 1
-                if every and (k + 1) % every == 0:
-                    rows = write_batch[rng.integers(
-                        0, len(write_batch), batch_rows)]
-                    insert_batch_into(self.proxy._insert_targets(), rows,
-                                      dedup=False)
-                    writes += 1
-            dur_s = max((get_usec() - t0) / 1e6, 1e-9)
-            s1 = obs.shadow.stats()
-            probes = (s1["hits"] + s1["misses"]
-                      - s0["hits"] - s0["misses"])
-            hits = s1["hits"] - s0["hits"]
-            phases.append({
-                "write_rate": float(write_rate),
-                "reads": reads, "served": served, "errors": errors,
-                "writes": writes,
-                "qps": round(reads / dur_s, 1),
-                "probes": probes, "hits": hits,
-                "hit_rate": round(hits / probes, 4) if probes else None,
-                "keys_killed": s1["killed"] - s0["killed"],
-            })
-            if write_rate == 0 and store_untouched is None:
-                # the observe-only proof: a full read phase (ledger +
-                # shadow probes on every reply) left the store
-                # bit-identical — content CRC and version both
-                store_untouched = (
-                    gstore_digest(g) == digest0
-                    and int(getattr(g, "version", 0)) == version0)
+                    deferred.append(text)
+            return ok
+
+        def verify_deferred() -> None:
+            """Zero-write phases: verify AFTER the timed window, once
+            per distinct (text, version) served — re-serving returns the
+            same resident entry the measured pass handed out, so the
+            oracle comparison witnesses exactly the measured bytes
+            without polluting the throughput measurement."""
+            for text in dict.fromkeys(deferred):
+                try:
+                    q = self.proxy.serve_query(text, blind=True,
+                                               tenant=tens[0])
+                    t1 = get_usec()
+                    oq = self._readmostly_oracle(text)
+                    oracle_us[0] += get_usec() - t1
+                    oracle_n[0] += 1
+                    if not _replies_identical(q, oq):
+                        mismatches[0] += 1
+                except Exception:
+                    mismatches[0] += 1
+            deferred.clear()
+
+        try:
+            phases = []
+            store_untouched = None
+            for write_rate in write_rates:
+                every = (int(round(1.0 / write_rate))
+                         if write_rate > 0 else 0)
+                if write_rate == 0 and store_untouched is None:
+                    # the observe-only proof brackets THIS phase (warmup
+                    # + measurement are both pure reads), wherever it
+                    # sits in the write_rates ordering
+                    digest0 = gstore_digest(g)
+                    version0 = int(getattr(g, "version", 0))
+                # warm the shadow population for THIS phase's steady
+                # state (uncounted — the hit rate models a long-running
+                # cache, not its cold start)
+                for k in range(warmup_reads):
+                    serve_one(k, measured=False)
+                s0 = obs.shadow.stats()
+                r0 = rc.stats() if rc is not None else None
+                c0, o0 = cached_us[0], oracle_us[0]
+                on0 = oracle_n[0]
+                served = errors = writes = 0
+                t0 = get_usec()
+                for k in range(reads):
+                    # write phases verify inline (each reply against the
+                    # store state IT saw); pure-read phases defer the
+                    # sweep past the timed window — the oracle's own
+                    # executions must not pollute the throughput number
+                    if serve_one(k, verify_inline=every > 0):
+                        served += 1
+                    else:
+                        errors += 1
+                    if every and (k + 1) % every == 0:
+                        rows = write_batch[rng.integers(
+                            0, len(write_batch), batch_rows)]
+                        insert_batch_into(self.proxy._insert_targets(),
+                                          rows, dedup=False)
+                        writes += 1
+                dur_s = max((get_usec() - t0) / 1e6, 1e-9)
+                s1 = obs.shadow.stats()
+                probes = (s1["hits"] + s1["misses"]
+                          - s0["hits"] - s0["misses"])
+                hits = s1["hits"] - s0["hits"]
+                phase = {
+                    "write_rate": float(write_rate),
+                    "reads": reads, "served": served, "errors": errors,
+                    "writes": writes,
+                    "qps": round(reads / dur_s, 1),
+                    "probes": probes, "hits": hits,
+                    "hit_rate": (round(hits / probes, 4)
+                                 if probes else None),
+                    "keys_killed": s1["killed"] - s0["killed"],
+                }
+                if rc is not None:
+                    r1 = rc.stats()
+                    rp = (r1["hits"] + r1["misses"]
+                          - r0["hits"] - r0["misses"])
+                    rh = r1["hits"] - r0["hits"]
+                    cs = max((cached_us[0] - c0) / 1e6, 1e-9)
+                    phase.update({
+                        "real_probes": rp, "real_hits": rh,
+                        "real_hit_rate": (round(rh / rp, 4)
+                                          if rp else None),
+                        "real_killed": r1["killed"] - r0["killed"],
+                        "cached_qps": round(reads / cs, 1),
+                    })
+                    verify_deferred()  # outside the throughput window
+                    on = oracle_n[0] - on0
+                    os_ = max((oracle_us[0] - o0) / 1e6, 1e-9)
+                    phase["uncached_qps"] = (round(on / os_, 1)
+                                             if on else None)
+                phases.append(phase)
+                if write_rate == 0 and store_untouched is None:
+                    # the observe-only proof: a full read phase (ledger +
+                    # shadow probes — and, cached, real fills — on every
+                    # reply) left the store bit-identical
+                    store_untouched = (
+                        gstore_digest(g) == digest0
+                        and int(getattr(g, "version", 0)) == version0)
+        finally:
+            Global.enable_result_cache, Global.enable_views = knobs0
         tsdb.sample_once()  # trend-window end marker
         # monotone degradation within a small jitter tolerance: compared
         # in WRITE-RATE order (not tuple order — a caller may interleave
@@ -866,6 +981,42 @@ class Emulator:
             "trend": reuse_trend(),
             "report": rep,
         }
+        if rc is not None:
+            # the actuator verdicts: real-vs-shadow parity on the
+            # zero-write phase, byte-identity against the oracle on
+            # EVERY measured reply, the in-run speedup, and (views) the
+            # flat-curve check — rung ii's whole point
+            zero = next((p for p in phases if p["write_rate"] == 0), None)
+            real_zero = zero.get("real_hit_rate") if zero else None
+            by_rate = sorted((p for p in phases
+                              if p.get("real_hit_rate") is not None),
+                             key=lambda p: p["write_rate"])
+            flat_pts = None
+            if (real_zero is not None and by_rate
+                    and by_rate[-1]["write_rate"] > 0):
+                flat_pts = round(
+                    (real_zero - by_rate[-1]["real_hit_rate"]) * 100, 1)
+            from wukong_tpu.serve.result_cache import divergence_total
+
+            out["real"] = {
+                "identical": mismatches[0] == 0,
+                "mismatches": mismatches[0],
+                "hit_rate": real_zero,
+                "shadow_predicted": predicted,
+                "beats_shadow": (real_zero is not None
+                                 and predicted is not None
+                                 and real_zero >= predicted - 1e-9),
+                "readmostly_qps": zero.get("cached_qps") if zero else None,
+                "uncached_qps": zero.get("uncached_qps") if zero else None,
+                "speedup_vs_uncached": (
+                    round(zero["cached_qps"] / zero["uncached_qps"], 2)
+                    if zero and zero.get("uncached_qps") else None),
+                "hit_rate_drop_pts": flat_pts,
+                "views_enabled": bool(views),
+                "divergence": divergence_total(),
+                "cache": rc.stats(),
+                "views": vr.stats() if vr is not None else None,
+            }
         log_info(
             "readmostly: predicted hit rate "
             + ("-" if predicted is None else f"{predicted:.1%}")
@@ -873,9 +1024,27 @@ class Emulator:
             + " ".join(f"w={p['write_rate']:g}:"
                        + ("-" if p["hit_rate"] is None
                           else f"{p['hit_rate']:.0%}")
+                       + ("" if p.get("real_hit_rate") is None
+                          else f"/real:{p['real_hit_rate']:.0%}")
                        for p in phases)
-            + f"; degrades={degrades}, store untouched={store_untouched}")
+            + f"; degrades={degrades}, store untouched={store_untouched}"
+            + (f"; cached identical={out['real']['identical']} "
+               f"qps={out['real']['readmostly_qps']} "
+               f"(x{out['real']['speedup_vs_uncached']}), "
+               f"drop={out['real']['hit_rate_drop_pts']}pts"
+               if rc is not None else ""))
         return out
+
+    def _readmostly_oracle(self, text: str):
+        """Uncached oracle execution for the cached drill's byte-identity
+        proof: the same parse/plan/execute path ``serve_query`` takes,
+        minus the admission/SLO/reuse reply hooks (they would double-
+        charge the observatory) and minus the result cache."""
+        q = self.proxy._parse_text(text)
+        self.proxy._plan_prepared(q, True, None, tenant="oracle")
+        eng = self.proxy._engine_for(q, None)
+        eng.execute(q)
+        return q
 
     # ------------------------------------------------------------------
     # multi-tenant SLO scenario (ROADMAP item 4 acceptance fixture)

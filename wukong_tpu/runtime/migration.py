@@ -426,6 +426,14 @@ class MigrationExecutor:
             # learn the new primary inside the SAME critical section, or
             # the next epoch would insert into the retired donor
             self._rebind_targets()
+            # the serving plane's actuator edge (wukong_tpu/serve/): the
+            # read-path swap purges the real result cache inside the
+            # same critical section — the clone is byte-identical, but a
+            # rotation-split read after the publication must never race
+            # a stale entry. One knob check when the cache is off.
+            from wukong_tpu.serve import notify_mutation
+
+            notify_mutation("cutover", shard=donor)
         job.cutover_pause_us = get_usec() - t0
         get_lineage().observe_store(ss)  # post-move lineage, immediately
         # cache-coherence telemetry (obs/reuse.py): a read-path swap is a
@@ -502,6 +510,12 @@ class MigrationExecutor:
                 ss.rollback_cutover(donor, job.donor_store, job.donor_host)
                 swapped = True
                 self._rebind_targets()
+                # the swap-back is a read-path publication like the
+                # cutover itself: the real result cache purges inside
+                # the same critical section (serve plane actuator edge)
+                from wukong_tpu.serve import notify_mutation
+
+                notify_mutation("cutover", shard=donor)
         return swapped
 
     # ------------------------------------------------------------------

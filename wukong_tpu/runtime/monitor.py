@@ -361,16 +361,35 @@ class Monitor:
                 f"{j['replayed']} WAL records caught up]"]
 
     def cache_lines(self) -> list[str]:
-        """Rolling-report line for the serving-cache observatory
-        (obs/reuse.py): shadow hit rate, resident keys, invalidation
-        kills, and the hottest template's share — quiet until any reply
-        has been observed (reuse off or no serving traffic)."""
+        """Rolling-report lines for the serving cache: the REAL result
+        cache + view registry (wukong_tpu/serve/) when the actuator is
+        on and probed, then the observatory's shadow line (obs/reuse.py)
+        — quiet until any reply has been observed (reuse off or no
+        serving traffic)."""
+        from wukong_tpu.config import Global
         from wukong_tpu.obs.reuse import get_reuse
 
+        lines = []
+        if Global.enable_result_cache:
+            from wukong_tpu.serve import get_serve
+            from wukong_tpu.serve.result_cache import divergence_total
+
+            rc = get_serve().cache.stats()
+            if rc["hits"] + rc["misses"]:
+                hr = rc["hit_rate"]
+                lines.append(
+                    "Cache[real "
+                    + ("-" if hr is None else f"{hr:.1%}")
+                    + f" over {rc['hits'] + rc['misses']:,} probes, "
+                    f"{rc['entries']} entries, "
+                    f"{rc['bytes_held'] / 2**20:.1f} MiB held, "
+                    f"{get_serve().views.count()} views, "
+                    f"{rc['collapsed']:,} collapsed, "
+                    f"diverged {divergence_total():,}]")
         obs = get_reuse()
         sh = obs.shadow.stats()
         if sh["hits"] + sh["misses"] == 0:
-            return []
+            return lines
         pop = obs.ledger.report(k=1)
         hot = ""
         if pop["ranked"]:
@@ -378,12 +397,13 @@ class Monitor:
             hot = (f", top {r['template']} {r['share']:.0%} "
                    f"@{r['rate_qps']:,.0f}q/s")
         hr = sh["hit_rate"]
-        return [f"Cache[shadow "
-                + ("-" if hr is None else f"{hr:.1%}")
-                + f" over {sh['hits'] + sh['misses']:,} probes, "
-                f"{sh['keys']} keys, {sh['killed']:,} killed, "
-                f"saved {sh['bytes_saved'] / 2**20:.1f} MiB"
-                f"{hot}]"]
+        lines.append(f"Cache[shadow "
+                     + ("-" if hr is None else f"{hr:.1%}")
+                     + f" over {sh['hits'] + sh['misses']:,} probes, "
+                     f"{sh['keys']} keys, {sh['killed']:,} killed, "
+                     f"saved {sh['bytes_saved'] / 2**20:.1f} MiB"
+                     f"{hot}]")
+        return lines
 
     def heat_lines(self, k: int = 3) -> list[str]:
         """Rolling-report lines: the top-k hot shards, only when any fetch

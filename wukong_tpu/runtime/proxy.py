@@ -161,6 +161,13 @@ class Proxy:
         breaker = getattr(getattr(dist_engine, "sstore", None), "breaker", None)
         if breaker is not None:
             self.monitor.attach_breaker("dist.shard", breaker)
+        # the materialized-view serving plane (wukong_tpu/serve/): bind
+        # the result cache + view registry to THIS proxy's host
+        # partition — a re-attach (new world in-process) purges entries
+        # and drops old-world view registrations wholesale
+        from wukong_tpu.serve import get_serve
+
+        get_serve().attach(self.g, self.str_server)
 
     def engine_pool(self):
         """Lazily-started host engine pool (N CPU engines with stealing and
@@ -186,9 +193,12 @@ class Proxy:
         blob = self._parse_cache.get(text)
         if blob is not None:
             _M_PARSE_CACHE.labels(result="hit").inc()
-            return pickle.loads(blob)
+            q = pickle.loads(blob)
+            q._qtext = text  # view promotion re-registers from the text
+            return q
         _M_PARSE_CACHE.labels(result="miss").inc()
         q = Parser(self.str_server).parse(text)
+        q._qtext = text
         try:
             self._parse_cache.put(
                 text, pickle.dumps(q, protocol=pickle.HIGHEST_PROTOCOL))
@@ -431,11 +441,18 @@ class Proxy:
         read it), so a write landing between plan and reply cannot file
         the key under a version the read never saw. Queries that skipped
         the plan path (user plan files) fall back to the current
-        version."""
-        maybe_observe_reuse(
+        version. With the real cache on, the shadow's verdict for this
+        reply is compared against the real probe's (stamped on the query
+        in ``_serve_execute``) — a disagreement on the same key counts
+        toward ``wukong_cache_divergence_total``."""
+        shadow_hit = maybe_observe_reuse(
             q, tenant,
             q.__dict__.get("_rver", getattr(self.g, "version", 0)),
             text=text)
+        if Global.enable_result_cache:
+            from wukong_tpu.serve.result_cache import note_shadow_outcome
+
+            note_shadow_outcome(q, shadow_hit)
 
     def _plan_prepared(self, qq: SPARQLQuery, blind, plan_text,
                        tenant: str = "default") -> None:
@@ -629,44 +646,66 @@ class Proxy:
         caller's pin. A query the planner routed ``wcoj`` executes on the
         tensor-join engine first — any join-phase failure (unsupported
         residue, injected ``join.materialize`` fault, a bug) degrades to
-        the walk below with the query untouched, never to an error."""
+        the walk below with the query untouched, never to an error.
+
+        With ``enable_result_cache`` on (wukong_tpu/serve/), the dispatch
+        is fronted by the version-keyed result cache: a hit installs the
+        cached reply and skips execution entirely; a miss may elect this
+        thread the key's request-collapsing leader, whose settlement (in
+        the ``finally``) fills the cache and wakes the followers —
+        whichever execution path below produced the reply."""
         from wukong_tpu.runtime import faults
 
         # the serving-boundary fault site: SLO-plane chaos scenarios
         # (Emulator.run_tenants) inject client-visible failures here so
-        # per-tenant error budgets burn through the real reply path
+        # per-tenant error budgets burn through the real reply path —
+        # BEFORE the cache probe, so cached traffic burns budgets too
         faults.site("proxy.serve")
-        if getattr(q, "join_strategy", "walk") == "wcoj" and not pinned \
-                and eng is not self.dist:
-            try:
-                self.wcoj().try_execute(q)
-                self._record_wcoj_feedback(q)
+        lease = None
+        if Global.enable_result_cache:
+            from wukong_tpu.serve import get_serve
+
+            served, lease = get_serve().cache.acquire(q)
+            if served:
                 return q
-            except Exception as e:
-                reason = (e.code.name if isinstance(e, WukongError)
-                          else type(e).__name__)
-                self._m_join_fallback.labels(reason=reason).inc()
-                tr = getattr(q, "trace", None)
-                if tr is not None:
-                    tr.event("join.fallback", reason=reason)
-                log_info(f"wcoj degraded to the walk ({reason})")
-        if Global.enable_batching and not pinned and eng is not None \
-                and eng is not self.dist:
-            pend = self.batcher().offer(q)
-            if pend is not None:
-                timeout = _batch_wait_timeout(q)
+        try:
+            if getattr(q, "join_strategy", "walk") == "wcoj" and not pinned \
+                    and eng is not self.dist:
                 try:
-                    pend.wait(timeout)
-                except TimeoutError:
-                    # a wedged batcher must not hang the serving thread
-                    # forever (the stream lane bounds its wait the same
-                    # way) — surface the failure instead
-                    log_error(f"batched dispatch not settled in "
-                              f"{timeout:.0f}s; batcher wedged?")
-                    raise
-                return q
-        eng.execute(q)  # batcher bypass: direct dispatch
-        return q
+                    self.wcoj().try_execute(q)
+                    self._record_wcoj_feedback(q)
+                    return q
+                except Exception as e:
+                    reason = (e.code.name if isinstance(e, WukongError)
+                              else type(e).__name__)
+                    self._m_join_fallback.labels(reason=reason).inc()
+                    tr = getattr(q, "trace", None)
+                    if tr is not None:
+                        tr.event("join.fallback", reason=reason)
+                    log_info(f"wcoj degraded to the walk ({reason})")
+            if Global.enable_batching and not pinned and eng is not None \
+                    and eng is not self.dist:
+                pend = self.batcher().offer(q)
+                if pend is not None:
+                    timeout = _batch_wait_timeout(q)
+                    try:
+                        pend.wait(timeout)
+                    except TimeoutError:
+                        # a wedged batcher must not hang the serving
+                        # thread forever (the stream lane bounds its wait
+                        # the same way) — surface the failure instead
+                        log_error(f"batched dispatch not settled in "
+                                  f"{timeout:.0f}s; batcher wedged?")
+                        raise
+                    return q
+            eng.execute(q)  # batcher bypass: direct dispatch
+            return q
+        finally:
+            if lease is not None:
+                # leader settlement: fill on SUCCESS+admission, and wake
+                # the followers either way (a failed leader must never
+                # strand its collapsed waiters)
+                lease.settle(q)
 
     def serve_query(self, text: str, blind: bool | None = None,
                     device: str | None = None,
@@ -677,7 +716,19 @@ class Proxy:
         path live traffic takes; run_single_query is the console surface.
         ``tenant`` is the caller's identity — stamped on the query, the
         trace, and every reply-side metric (bounded to ``max_tenants``
-        label values), and fed to the SLO tracker at reply."""
+        label values), and fed to the SLO tracker at reply.
+
+        With ``enable_result_cache`` on, a repeated text whose key is
+        resident at the current store version serves on the zero-parse
+        fast path: the text resolves straight to its cache key (learned
+        at fill time), skipping parse + plan entirely — the reply-side
+        accounting (tenant admission, SLO, reuse observatory, the
+        ``proxy.serve`` fault site) still runs in full."""
+        if Global.enable_result_cache and device is None \
+                and not Global.enable_tracing:
+            q = self._serve_fast_hit(text, blind, tenant)
+            if q is not None:
+                return q
         trace = maybe_start_trace(kind="query", text=text)
         t0_us = get_usec()
         ten = self._admit(tenant)
@@ -715,6 +766,48 @@ class Proxy:
         self._observe_slo(ten, get_usec() - t0_us,
                           ok=status == ErrorCode.SUCCESS, status=status,
                           trace=trace)
+        self._observe_reuse(q, ten, text)
+        return q
+
+    def _serve_fast_hit(self, text: str, blind, tenant: str):
+        """The zero-parse cached-serving path: resolve the text to its
+        cache key via the fill-time memo and, on a fresh-version hit,
+        reply from the cached entry without parsing or planning. Returns
+        None on any miss — the caller falls through to the full path
+        (which probes the same key again, with collapsing). Skipped
+        under tracing (a traced reply keeps its parse/plan spans) and
+        for pinned-device requests."""
+        from wukong_tpu.serve import get_serve
+
+        eff_blind = Global.silent if blind is None else bool(blind)
+        rc = get_serve().cache
+        found = rc.fast_probe(text, eff_blind,
+                              int(getattr(self.g, "version", 0)))
+        if found is None:
+            return None
+        key, ent = found
+        t0_us = get_usec()
+        ten = self._admit(tenant)
+        try:
+            from wukong_tpu.runtime import faults
+
+            # chaos parity: cached traffic crosses the same serving
+            # boundary (and burns the same SLO budgets) as executed
+            # traffic
+            faults.site("proxy.serve")
+        except Exception as e:
+            code = e.code if isinstance(e, WukongError) else "ERROR"
+            self._m_queries.labels(
+                status=code.name if isinstance(code, ErrorCode)
+                else str(code), tenant=ten).inc()
+            self._observe_slo(ten, get_usec() - t0_us, ok=False,
+                              status=code, trace=None)
+            raise
+        q = rc.build_reply(key, ent)
+        q.tenant = ten
+        self._m_queries.labels(status="SUCCESS", tenant=ten).inc()
+        self._observe_slo(ten, get_usec() - t0_us, ok=True,
+                          status=ErrorCode.SUCCESS, trace=None)
         self._observe_reuse(q, ten, text)
         return q
 

@@ -377,6 +377,13 @@ class RecoveryManager:
 
         maybe_note_invalidation("restore", version=None,
                                 checkpoint=stats["checkpoint"])
+        # the serving plane's actuator edge (wukong_tpu/serve/): the
+        # restored world's version counters are not comparable to the
+        # cached keys' — the real result cache purges conservatively.
+        # One knob check when the cache is off.
+        from wukong_tpu.serve import notify_mutation
+
+        notify_mutation("restore")
         if self.on_change is not None:
             self.on_change()
         log_info(f"recovery: checkpoint={stats['checkpoint']} "

@@ -1,0 +1,79 @@
+"""The materialized-view serving plane (ROADMAP item 7's actuator).
+
+Two rungs over PR 13's serving-cache observatory:
+
+- :mod:`wukong_tpu.serve.result_cache` — rung i, the version-keyed
+  full-result cache in the proxy reply path (admission by the popularity
+  ledger's verdicts, request collapsing, bounded bytes);
+- :mod:`wukong_tpu.serve.views` — rung ii, hot templates promoted into
+  incrementally-maintained standing results via the Wukong+S semi-naive
+  delta planner, so cache hits survive store-version edges.
+
+:func:`notify_mutation` is THE mutation hook (the ``cache.invalidate``
+edge set, ``MUTATION_EDGES`` — gate-enforced against
+``INVALIDATION_CAUSES``): insert batches and stream epochs call it
+INSIDE the WAL-mutation-locked commit so a view is never visible at a
+version it doesn't match; migration cutover and recovery restore call
+it at their swap points for the conservative purge. One knob check when
+the cache is off (``enable_result_cache``, default OFF — the serving
+path is byte-for-byte unchanged, the PR 12 actuator posture).
+"""
+
+from __future__ import annotations
+
+from wukong_tpu.config import Global
+from wukong_tpu.serve.result_cache import ResultCache
+from wukong_tpu.serve.views import ViewRegistry
+
+__all__ = ["ServePlane", "get_serve", "notify_mutation"]
+
+
+class ServePlane:
+    """The process-wide serving-reuse plane: one result cache + one view
+    registry, wired so a cache key's version-edge votes promote its
+    template and a view's survival verdict re-keys its entries."""
+
+    def __init__(self):
+        self.cache = ResultCache()
+        self.views = ViewRegistry()
+        self.cache.on_promote = self.views.promote
+
+    def attach(self, gstore, str_server) -> None:
+        """Bind to a (new) serving world (the proxy's host partition):
+        stale entries and old-world view registrations drop."""
+        self.views.attach(gstore, str_server)
+        self.cache.purge()
+
+    def on_mutation(self, cause: str, version=None, triples=None) -> None:
+        """One journaled mutation edge (MUTATION_EDGES semantics)."""
+        if cause in ("cutover", "restore"):
+            self.cache.purge()
+            return
+        survivors = set()
+        if Global.enable_views and triples is not None:
+            survivors = self.views.on_mutation(triples, version or 0)
+        self.cache.apply_edge(version or 0, survivors)
+
+    def reset(self) -> None:
+        from wukong_tpu.serve.result_cache import reset_divergence
+
+        self.cache.reset()
+        self.views.reset()
+        reset_divergence()
+
+
+_plane = ServePlane()
+
+
+def get_serve() -> ServePlane:
+    return _plane
+
+
+def notify_mutation(cause: str, version=None, triples=None,
+                    shard=None) -> None:
+    """THE serving-plane mutation hook (cache-coherence gate contract:
+    every declared invalidation cause has exactly this consumer). One
+    knob check when the result cache is off."""
+    if not Global.enable_result_cache:
+        return
+    _plane.on_mutation(cause, version=version, triples=triples)

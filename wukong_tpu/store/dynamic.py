@@ -240,6 +240,7 @@ def insert_batch_into(stores: list[GStore], triples: np.ndarray,
     lock keeps the append + fan-out atomic w.r.t. checkpoint
     serialization (runtime/recovery.py)."""
     from wukong_tpu.obs.reuse import maybe_note_invalidation
+    from wukong_tpu.serve import notify_mutation
     from wukong_tpu.store.wal import maybe_wal_append, mutation_lock
 
     with mutation_lock():
@@ -253,6 +254,15 @@ def insert_batch_into(stores: list[GStore], triples: np.ndarray,
         # and the sink is a transient mirror of a store already counted
         for g in migration_sinks():
             insert_triples(g, triples, dedup, check_ids=False)
+        # the serving plane's actuator edge (wukong_tpu/serve/): INSIDE
+        # the mutation lock, so view maintenance re-keys surviving cache
+        # entries atomically with the version bump — a view is never
+        # visible at a version it doesn't match. One knob check when the
+        # result cache is off.
+        if stores:
+            notify_mutation("insert",
+                            version=getattr(stores[0], "version", 0),
+                            triples=triples)
     # cache-coherence telemetry (obs/reuse.py): the batch's version edge
     # kills the stale shadow keys and lands one cache.invalidate event.
     # Outside the mutation lock — the journal emit is pure observability

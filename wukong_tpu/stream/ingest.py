@@ -257,6 +257,15 @@ class StreamIngestor:
                     with trace.span("stream.eval", epoch=self.epoch):
                         rec.eval_us = self.continuous.on_epoch(
                             self.epoch, triples, rec.ts)
+            # the serving plane's actuator edge (wukong_tpu/serve/):
+            # INSIDE the mutation lock — materialized-view maintenance
+            # re-keys surviving result-cache entries atomically with the
+            # epoch's version bump (a view is never visible at a version
+            # it doesn't match). One knob check when the cache is off.
+            from wukong_tpu.serve import notify_mutation
+
+            notify_mutation("epoch", version=rec.version,
+                            triples=triples)
         # cache-coherence telemetry (obs/reuse.py): the epoch's version
         # edge kills stale shadow keys + journals cache.invalidate —
         # outside the mutation lock, pure observability
