@@ -1197,39 +1197,78 @@ def cyclic_main(device_ok: bool) -> None:
         detail[name] = _cyclic_case(name, wg, wstats, wplanner, spec, mkq,
                                     CPUEngine, WCOJExecutor, reps)
     tri = detail["triangle"]
+    rows_identical = all(d["rows_identical"] for d in detail.values())
+    device_speedups = {n: d["device_speedup"] for n, d in detail.items()}
+    # default=None: a reduced-scale run can round every device_ms to 0.0
+    # (speedup None) — the artifact must still emit and the NOGATE escape
+    # hatch must still work instead of crashing on an empty max()
+    device_speedup_max = max(
+        (v for v in device_speedups.values() if v is not None),
+        default=None)
+    pentagon_auto = detail["w_pentagon"]["auto_vs_walk"]
     _emit_final({
         "metric": f"cyclic suite: WCOJ vs walk (triangle m={m_tri} "
                   f"headline; diamond/clique4 + WatDiv-{wscale} cyclic "
-                  "set in detail)",
+                  "set + the XLA device route in detail)",
         "value": tri["speedup"],
         "unit": "speedup",
         "triangle_speedup": tri["speedup"],
         "triangle_walk_ms": tri["walk_ms"],
         "triangle_wcoj_ms": tri["wcoj_ms"],
-        "rows_identical": all(d["rows_identical"] for d in detail.values()),
+        "rows_identical": rows_identical,
         "auto_strategies": {n: d["auto_strategy"] for n, d in detail.items()},
         # settled-auto wall over the forced walk, per case (>= ~1.0 means
-        # the measured-blowup feedback keeps auto from losing to the walk)
+        # the measured feedback loops keep auto from losing to the walk;
+        # the w_pentagon >= 1.0 gate below is the PR 10 exception, closed
+        # by the device route)
         "auto_vs_walk": {n: d["auto_vs_walk"] for n, d in detail.items()},
         "auto_vs_walk_min": min(d["auto_vs_walk"] for d in detail.values()),
-        "backend": "cpu",  # host executors on both sides (the XLA path
-        # rides the same kernels; the strategy win is algorithmic)
+        # device-vs-host WCOJ per case, plus the w_pentagon headline the
+        # trajectory trends (bench_report.py secondary series): pentagon
+        # is the shape whose loss WAS closing-level intersection cost
+        "device_speedup": device_speedups,
+        "device_speedup_max": device_speedup_max,
+        "pentagon_device_speedup": detail["w_pentagon"]["device_speedup"],
+        "backend": "cpu",  # host walk/wcoj; the device route is the same
+        # XLA kernels the TPU path jits (CPU backend in this container)
         "detail": {**detail,
                    "knobs": {"wcoj_ratio": Global.wcoj_ratio,
                              "wcoj_min_rows": Global.wcoj_min_rows,
+                             "join_device": Global.join_device,
+                             "join_device_min_candidates":
+                                 Global.join_device_min_candidates,
                              "reps": reps}},
     }, "BENCH_CYCLIC.json")
+    # the drill self-gates (ci_check runs it): byte-identity across all
+    # three executors on every case, the w_pentagon auto-routing
+    # exception closed (>= 1.0 vs the walk with the device route on),
+    # and a real device win somewhere (>= 1.5x device-vs-host).
+    # WUKONG_CYCLIC_NOGATE=1 skips the gates for reduced-scale local runs
+    if os.environ.get("WUKONG_CYCLIC_NOGATE") != "1":
+        if not rows_identical:
+            raise SystemExit("cyclic drill FAILED: rows not identical "
+                             "across walk/wcoj/device")
+        if pentagon_auto is None or pentagon_auto < 1.0:
+            raise SystemExit(
+                f"cyclic drill FAILED: w_pentagon auto_vs_walk "
+                f"{pentagon_auto} < 1.0 (the auto-routing exception "
+                "must stay closed)")
+        if device_speedup_max is None or device_speedup_max < 1.5:
+            raise SystemExit(
+                f"cyclic drill FAILED: best device-vs-host speedup "
+                f"{device_speedup_max} < 1.5")
 
 
 def _cyclic_case(name, g, stats, planner, spec, mkq, CPUEngine,
                  WCOJExecutor, reps: int) -> dict:
-    """One cyclic-suite case: plan once, run walk-forced and wcoj-forced,
-    compare rows and best-of-reps wall time. Additionally runs the AUTO
-    route through a real proxy so the measured-blowup feedback loop
-    (Proxy._record_wcoj_feedback) settles the strategy the way live
-    serving would — the artifact records both the first (estimate-driven)
-    and the settled (measurement-corrected) decision plus the settled
-    auto wall time."""
+    """One cyclic-suite case: plan once, run walk-forced, wcoj-forced
+    (host route), and wcoj device-forced (XLA level path), compare rows
+    and best-of-reps wall time. Additionally runs the AUTO route through
+    a real proxy so the measured-blowup + measured-candidate feedback
+    loops (Proxy._record_wcoj_feedback / _record_route_feedback) settle
+    the strategy and route the way live serving would — the artifact
+    records both the first (estimate-driven) and the settled
+    (measurement-corrected) decision plus the settled auto wall time."""
     from wukong_tpu.config import Global
     from wukong_tpu.runtime.proxy import Proxy
 
@@ -1248,6 +1287,8 @@ def _cyclic_case(name, g, stats, planner, spec, mkq, CPUEngine,
     def auto_run():
         q = planned()
         q.join_strategy = proxy.classify_join_strategy(q)
+        if q.join_strategy == "wcoj":
+            q.join_route = proxy.classify_join_route(q)
         t0 = time.perf_counter()
         proxy._serve_execute(q, cpu)
         assert q.result.status_code == 0, (name, q.result.status_code)
@@ -1273,6 +1314,16 @@ def _cyclic_case(name, g, stats, planner, spec, mkq, CPUEngine,
 
     walk_ms, walk_rows, walk_set = run(cpu)
     wcoj_ms, wcoj_rows, wcoj_set = run(wc)
+    # the DEVICE route forced on the same planned query (shared table
+    # cache — the sorted tables are route-independent; the device twins
+    # build once and stay resident across reps, the serving steady state)
+    prev_dev = Global.join_device
+    Global.join_device = "device"
+    try:
+        wcd = WCOJExecutor(g, stats=stats, tables=wc.tables)
+        device_ms, device_rows, device_set = run(wcd)
+    finally:
+        Global.join_device = prev_dev
     # the auto route with measured feedback: the first run may route wcoj
     # on the over-predicted estimate, measure its prefix blowup, and
     # demote; best-of-reps is taken AFTER the decision settles
@@ -1285,8 +1336,13 @@ def _cyclic_case(name, g, stats, planner, spec, mkq, CPUEngine,
         "walk_ms": round(walk_ms, 1), "wcoj_ms": round(wcoj_ms, 1),
         "speedup": round(walk_ms / wcoj_ms, 2) if wcoj_ms else None,
         "rows": int(walk_rows),
-        "rows_identical": bool(walk_rows == wcoj_rows
-                               and walk_set == wcoj_set),
+        "rows_identical": bool(walk_rows == wcoj_rows == device_rows
+                               and walk_set == wcoj_set == device_set),
+        "device_ms": round(device_ms, 1),
+        "device_speedup": (round(wcoj_ms / device_ms, 2)
+                           if device_ms else None),
+        "device_vs_walk": (round(walk_ms / device_ms, 2)
+                           if device_ms else None),
         "auto_strategy": settled,
         "auto_first_strategy": first_strategy,
         "auto_first_ms": round(first_ms, 1),

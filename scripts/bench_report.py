@@ -33,6 +33,14 @@ import sys
 
 RUNG_RE = re.compile(r"^(BENCH(?:_[A-Za-z0-9]+)*?)_r(\d+)$")
 
+#: secondary headlines: (field, unit) pairs an artifact may carry IN
+#: ADDITION to its primary headline; each present field becomes its own
+#: `<series>.<field>` trend series (e.g. BENCH_CYCLIC's
+#: pentagon_device_speedup — the device-vs-host WCOJ win on the shape
+#: whose loss was closing-level intersection cost — trends next to the
+#: triangle walk-vs-wcoj primary instead of displacing it)
+SECONDARY_HEADLINES = (("pentagon_device_speedup", "speedup"),)
+
 LOWER_BETTER = ("us", "ms", "ns", "sec")
 HIGHER_BETTER = ("q/s", "qps", "/s", "speedup")
 
@@ -131,6 +139,14 @@ def collect(bench_dir: str) -> dict:
                                      "metric": head["metric"], "points": []})
         s["points"].append({"rung": rung, "file": base,
                             "value": head["value"]})
+        body = d["parsed"] if isinstance(d.get("parsed"), dict) else d
+        for field, unit in SECONDARY_HEADLINES:
+            if isinstance(body.get(field), (int, float)):
+                s2 = series.setdefault(
+                    f"{name}.{field}",
+                    {"unit": unit, "metric": field, "points": []})
+                s2["points"].append({"rung": rung, "file": base,
+                                     "value": float(body[field])})
     for s in series.values():
         s["points"].sort(key=lambda p: (p["rung"] is not None, p["rung"]))
         s["direction"] = _direction(s["unit"])

@@ -31,6 +31,13 @@ echo "== read-mostly serving drill (shadow + CACHED acceptance) =="
 # within 15 points of zero-write — exits non-zero otherwise)
 JAX_PLATFORMS=cpu python bench.py --readmostly
 
+echo "== cyclic device-route drill (WCOJ host/device/walk identity) =="
+# the cyclic suite with the XLA device route: every case byte-identical
+# across walk / host-wcoj / device-wcoj, the w_pentagon auto-routing
+# exception closed (auto >= 1.0 vs the walk), and >= 1.5x device-vs-host
+# on at least one case (exits non-zero otherwise; see cyclic_main gates)
+JAX_PLATFORMS=cpu python bench.py --cyclic
+
 echo "== bench trajectory check =="
 python scripts/bench_report.py --check
 

@@ -5,6 +5,14 @@ rows to the walk AND to the independent brute-force BGP oracle on triangle,
 diamond, and 4-clique worlds; acyclic LUBM reference shapes route ``walk``
 under ``join_strategy auto``; and a ``join.materialize`` fault degrades the
 query to the walk — never to an error.
+
+ISSUE 15 adds the device plane: the XLA level route is byte-identical to
+the host kernels (including padded/bucketed edge cases through the jitted
+kernels), any device failure degrades to host, the route chooser is
+memoized + feedback-demotable, and the DISTRIBUTED generic join fans a
+cyclic query across a >= 4-shard store on the heavy lane with
+byte-identical gathered rows, a per-slice ``join.slice`` chaos fallback,
+and the whole drill lockdep-checked.
 """
 
 import sys
@@ -70,6 +78,9 @@ def _clean_faults_and_knobs():
     Global.join_strategy = "auto"
     Global.wcoj_ratio = 4
     Global.wcoj_min_rows = 8192
+    Global.join_device = "auto"
+    Global.join_device_min_candidates = 65536
+    Global.join_dist_parts = 4
 
 
 def mkq(meta, blind=False) -> SPARQLQuery:
@@ -527,6 +538,496 @@ def test_join_gate_flags_missing_registry(tmp_path):
     assert len(bad) == 1 and "JOIN_STRATEGIES" in bad[0].message
 
 
+# ---------------------------------------------------------------------------
+# the device route: padded/bucketed kernel parity (ISSUE 15 satellite)
+# ---------------------------------------------------------------------------
+
+def _rand_csr(seed=7, nk=60, ne=400, vmax=80):
+    from wukong_tpu.store.segment import CSRSegment
+
+    rng = np.random.default_rng(seed)
+    return CSRSegment.from_pairs(rng.integers(0, nk, ne),
+                                 rng.integers(0, vmax, ne)), rng
+
+
+def test_kernels_jit_empty_candidate_lists():
+    """Zero-length candidate vectors through the jitted kernels match the
+    NumPy kernels (both all-empty, no shape errors)."""
+    from wukong_tpu.join.kernels import jit_kernels
+
+    member, pair = jit_kernels()
+    seg, _ = _rand_csr()
+    empty = np.empty(0, dtype=np.int64)
+    assert np.asarray(member(np.array([1, 3, 5]), empty)).shape == (0,)
+    got = np.asarray(pair(seg.keys, seg.offsets, seg.edges, empty, empty))
+    assert got.shape == (0,)
+    assert np.array_equal(got, pair_member(seg.keys, seg.offsets,
+                                           seg.edges, empty, empty))
+
+
+def test_kernels_level_probe_all_padding_and_singletons():
+    """The padded level probe: all-padding buckets come back all-False,
+    singleton ragged rows (degree-1 runs) and live/padding mixes match
+    the NumPy twin exactly."""
+    from wukong_tpu.join.kernels import (
+        jit_level_probe,
+        level_probe_host,
+        pad_pow2,
+        to_device_i32,
+    )
+
+    seg, rng = _rand_csr(seed=11)
+    # degree-1 CSR (singleton ragged rows) as the second adjacency
+    from wukong_tpu.store.segment import CSRSegment
+
+    k1 = np.arange(50)
+    seg1 = CSRSegment.from_pairs(k1, rng.integers(0, 80, 50))
+    glob = np.unique(rng.integers(0, 80, 30))
+    for C in (0, 1, 7, 33):  # incl. the all-padding bucket (C == 0)
+        Cp = pad_pow2(C, floor=16)
+        valid = np.zeros(Cp, dtype=bool)
+        valid[:C] = True
+        cand = rng.integers(0, 80, Cp).astype(np.int64)
+        a0 = rng.integers(0, 60, Cp).astype(np.int64)
+        a1 = rng.integers(0, 50, Cp).astype(np.int64)
+        want = level_probe_host(valid, cand, glob,
+                                seg.keys, seg.offsets, seg.edges, a0,
+                                seg1.keys, seg1.offsets, seg1.edges, a1)
+        fn = jit_level_probe((8, 2), True)  # generous depths converge
+        got = np.asarray(fn(
+            np.asarray(valid), to_device_i32(cand), to_device_i32(glob),
+            to_device_i32(seg.keys), to_device_i32(seg.offsets),
+            to_device_i32(seg.edges), to_device_i32(a0),
+            to_device_i32(seg1.keys), to_device_i32(seg1.offsets),
+            to_device_i32(seg1.edges), to_device_i32(a1)))
+        assert np.array_equal(got, want), C
+        if C == 0:
+            assert not got.any()  # all-padding: nothing may pass
+
+
+def test_kernels_depth_bounded_pair_member_parity():
+    """The device path's log2(max_degree)+1 iteration bound converges to
+    the same mask as the generic log2(len(edges))+1 bound."""
+    seg, rng = _rand_csr(seed=13, nk=40, ne=800, vmax=100)
+    anchors = rng.integers(0, 50, 500)
+    vals = rng.integers(0, 100, 500)
+    max_deg = int(np.diff(seg.offsets).max())
+    depth = max(max_deg, 1).bit_length() + 1
+    assert np.array_equal(
+        pair_member(seg.keys, seg.offsets, seg.edges, anchors, vals),
+        pair_member(seg.keys, seg.offsets, seg.edges, anchors, vals,
+                    depth=depth))
+
+
+def test_kernels_jit_values_past_int31_under_x64():
+    """>2^31-safe ids/offsets through the jitted kernels: under
+    ``jax.experimental.enable_x64`` the SAME kernel source runs int64 and
+    matches NumPy on values past int32 range. (The default x64-off device
+    path never sees such values — ``to_device_i32`` REFUSES them and the
+    executor degrades to host, tested below.)"""
+    from jax.experimental import enable_x64
+
+    from wukong_tpu.join.kernels import jit_kernels
+
+    big = np.int64(1) << 32
+    keys = np.array([2, 5, 9], dtype=np.int64)
+    edges = np.array([big + 1, big + 7, big + 3, big + 9, big + 5],
+                     dtype=np.int64)
+    offsets = np.array([0, 2, 4, 5], dtype=np.int64)
+    anchors = np.array([2, 2, 5, 9, 7], dtype=np.int64)
+    vals = np.array([big + 1, big + 3, big + 9, big + 5, big + 1],
+                    dtype=np.int64)
+    want_pair = pair_member(keys, offsets, edges, anchors, vals)
+    want_member = member_sorted(np.sort(edges), vals)
+    with enable_x64():
+        member, pair = jit_kernels()
+        got_pair = np.asarray(pair(keys, offsets, edges, anchors, vals))
+        got_member = np.asarray(member(np.sort(edges), vals))
+    assert np.array_equal(got_pair, want_pair)
+    assert np.array_equal(got_member, want_member)
+
+
+def test_to_device_i32_refuses_out_of_range():
+    """Offsets/ids past int32 must refuse (DeviceRangeError -> host
+    fallback), never silently truncate."""
+    from wukong_tpu.join.kernels import DeviceRangeError, to_device_i32
+
+    with pytest.raises(DeviceRangeError):
+        to_device_i32(np.array([0, 1, 1 << 31], dtype=np.int64))
+    ok = to_device_i32(np.array([0, (1 << 31) - 1], dtype=np.int64))
+    assert np.asarray(ok).tolist() == [0, (1 << 31) - 1]
+
+
+def test_stream_seed_masks_device_parity():
+    """The stream subsystem's device-batched frontier seeding: one fused
+    call's per-term masks reproduce match_delta's host seeds exactly
+    (const endpoints, wildcards, repeated-var equality)."""
+    from wukong_tpu.stream.continuous import device_seed_masks, match_delta
+
+    rng = np.random.default_rng(3)
+    triples = np.stack([rng.integers(100, 130, 400),
+                        rng.integers(2, 6, 400),
+                        rng.integers(100, 130, 400)], axis=1).astype(np.int64)
+    pats = [Pattern(-1, 3, OUT, -2),          # both ends free
+            Pattern(112, 4, OUT, -2),         # const subject
+            Pattern(-1, 2, OUT, 105),         # const object
+            Pattern(-1, 5, OUT, -1),          # repeated var: s == o
+            Pattern(-2, 3, IN, -1)]           # engine-form IN orientation
+    Global.join_device = "device"  # force past the amortization floor
+    masks = device_seed_masks(pats, triples)
+    assert masks is not None and masks.shape == (len(pats), len(triples))
+    for i, pat in enumerate(pats):
+        vh, sh = match_delta(pat, triples)
+        vd, sd = match_delta(pat, triples, row_mask=masks[i])
+        assert vh == vd
+        assert np.array_equal(sh, sd), i
+    Global.join_device = "host"  # pinned host: no device masks
+    assert device_seed_masks(pats, triples) is None
+
+
+# ---------------------------------------------------------------------------
+# the device route: executor identity, fallback, chooser, feedback
+# ---------------------------------------------------------------------------
+
+def test_wcoj_device_route_byte_identical(world):
+    """Forced ``join_device device``: every level probes on the XLA path
+    and the result TABLE (rows AND order) is byte-identical to the host
+    route — same candidate enumeration, same mask semantics."""
+    name, _t, g, stats, meta = world
+    qh, qd = mkq(meta), mkq(meta)
+    heuristic_plan(qh)
+    heuristic_plan(qd)
+    WCOJExecutor(g, stats=stats).execute(qh)
+    Global.join_device = "device"
+    WCOJExecutor(g, stats=stats).execute(qd)
+    assert qd.result.status_code == ErrorCode.SUCCESS
+    assert np.array_equal(qh.result.table, qd.result.table), name
+    assert all(lv["route"] == "device" for lv in qd.join_stats), name
+    assert all(lv["route"] == "host" for lv in qh.join_stats), name
+
+
+def test_wcoj_device_failure_degrades_to_host(world, monkeypatch):
+    """Any device-path failure degrades the level (and latches the rest
+    of the query) to the host kernels — correct rows, never an error."""
+    name, _t, g, stats, meta = world
+    Global.join_device = "device"
+    wc = WCOJExecutor(g, stats=stats)
+    monkeypatch.setattr(
+        WCOJExecutor, "_probe_device",
+        lambda self, *a, **k: (_ for _ in ()).throw(RuntimeError("boom")))
+    q = mkq(meta)
+    heuristic_plan(q)
+    wc.execute(q)
+    assert q.result.status_code == ErrorCode.SUCCESS
+    assert all(lv["route"] == "host" for lv in q.join_stats)
+    qh = mkq(meta)
+    heuristic_plan(qh)
+    Global.join_device = "host"
+    WCOJExecutor(g, stats=stats).execute(qh)
+    assert rows_of(q) == rows_of(qh), name
+
+
+def test_choose_join_route_knob_and_threshold(world):
+    from wukong_tpu.join import JOIN_ROUTES
+
+    _name, _t, _g, stats, meta = world
+    pl = Planner(stats)
+    q = mkq(meta)
+    pl.generate_plan(q)
+    pats = q.pattern_group.patterns
+    Global.join_device = "host"
+    assert pl.choose_join_route(pats) == "host"
+    Global.join_device = "device"
+    assert pl.choose_join_route(pats) == "device"
+    Global.join_device = "auto"
+    assert pl.choose_join_route(pats) in JOIN_ROUTES
+    # the dispatch-amortization threshold: floor of 1 routes any
+    # estimable chain device, an absurd floor routes host
+    Global.join_device_min_candidates = 1
+    assert pl.choose_join_route(pats) == "device"
+    Global.join_device_min_candidates = 1 << 60
+    assert pl.choose_join_route(pats) == "host"
+
+
+def test_proxy_route_memoized_and_demoted(tri_proxy, monkeypatch):
+    """The route decision is memoized through the plan cache and the
+    measured-candidate feedback demotes an over-predicted device route
+    back to host for the next same-template query (the PR 10 pattern)."""
+    from wukong_tpu.planner.optimizer import Planner as _P
+
+    proxy, text = tri_proxy
+    Global.wcoj_min_rows = 1
+    Global.wcoj_ratio = 1
+    monkeypatch.setattr(_P, "choose_join_route",
+                        lambda self, pats: "device")
+    q = proxy.run_single_query(text, blind=False)
+    assert q.join_strategy == "wcoj" and q.join_route == "device"
+    # the tiny triangle world's measured candidates sit far under the
+    # (default) threshold -> the feedback demotes the memoized route
+    q2 = proxy.run_single_query(text, blind=False)
+    assert q2.join_route == "host"
+    # a knob flip re-arms the estimate-driven decision (new memo key)
+    Global.join_device_min_candidates = 1
+    q3 = proxy.run_single_query(text, blind=False)
+    assert q3.join_route == "device"
+
+
+def test_proxy_route_demoted_after_device_failure(tri_proxy, monkeypatch):
+    """A device path that failed mid-query (latched host) demotes the
+    template's memoized route — a deterministic failure is paid once,
+    not re-attempted per query."""
+    from wukong_tpu.planner.optimizer import Planner as _P
+
+    proxy, text = tri_proxy
+    Global.wcoj_min_rows = 1
+    Global.wcoj_ratio = 1
+    Global.join_device_min_candidates = 1  # measured volume never demotes
+    monkeypatch.setattr(_P, "choose_join_route",
+                        lambda self, pats: "device")
+    monkeypatch.setattr(
+        WCOJExecutor, "_probe_device",
+        lambda self, *a, **k: (_ for _ in ()).throw(RuntimeError("boom")))
+    q = proxy.run_single_query(text, blind=False)
+    assert q.result.status_code == ErrorCode.SUCCESS
+    assert q.join_route == "device"  # routed device, degraded internally
+    q2 = proxy.run_single_query(text, blind=False)
+    assert q2.join_route == "host"  # the failure latched the memo
+
+
+def test_explain_renders_route_line(tri_proxy):
+    proxy, text = tri_proxy
+    Global.wcoj_min_rows = 1
+    Global.wcoj_ratio = 1
+    Global.join_device = "device"
+    rep = proxy.explain_query(text, analyze=True)
+    assert rep["strategy"] == "wcoj"
+    assert rep["route"] == "device"
+    assert "route: device" in rep["rendered"]
+    assert all(lv["route"] == "device" for lv in rep["wcoj_levels"])
+
+
+# ---------------------------------------------------------------------------
+# the distributed generic join: heavy-lane fan-out over a 4-shard store
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def lockdep_checked():
+    """The distributed-join drill runs fully lockdep-checked: every lock
+    the pool/slices create is a Debug wrapper feeding the
+    acquisition-order graph; teardown asserts zero order cycles and zero
+    declared-leaf inversions."""
+    from wukong_tpu.analysis import lockdep
+
+    lockdep.install(True)
+    yield
+    try:
+        assert lockdep.cycles() == [], lockdep.cycles()
+        assert lockdep.leaf_violations() == [], lockdep.leaf_violations()
+    finally:
+        lockdep.install(False)
+
+
+@pytest.fixture()
+def dist_world(lockdep_checked):
+    """A 4-shard triangle world + a started host engine pool (locks built
+    under the lockdep fixture so the whole drill is order-checked)."""
+    from wukong_tpu.runtime.scheduler import EnginePool
+    from wukong_tpu.store.gstore import build_partition
+
+    triples, meta = generate_triangle(m=80, noise=4, seed=2)
+    g1 = build_partition(triples, 0, 1)
+    parts = [build_partition(triples, k, 4) for k in range(4)]
+    stats = Stats.generate(triples)
+    pool = EnginePool(num_engines=4,
+                      make_engine=lambda tid: CPUEngine(g1))
+    pool.start()
+    yield g1, parts, stats, meta, pool
+    pool.stop()
+
+
+def _heavy_submitted(pool) -> float:
+    from wukong_tpu.obs.metrics import get_registry
+
+    for s in get_registry().snapshot().get(
+            "wukong_pool_submitted_total", {}).get("series", []):
+        if s["labels"].get("lane") == "heavy":
+            return s["value"]
+    return 0.0
+
+
+def test_dist_join_fans_out_and_gathers_identical(dist_world):
+    """The drill: a cyclic query over a 4-shard store fans out on the
+    heavy lane (pool submissions counted), and the gathered rows are
+    byte-identical (sorted) to the single-engine WCOJ and the walk."""
+    from wukong_tpu.join.dist import DistributedWCOJExecutor
+
+    g1, parts, stats, meta, pool = dist_world
+    qw = mkq(meta)
+    heuristic_plan(qw)
+    CPUEngine(g1).execute(qw)
+    q1 = mkq(meta)
+    heuristic_plan(q1)
+    WCOJExecutor(g1, stats=stats).execute(q1)
+    before = _heavy_submitted(pool)
+    qd = mkq(meta)
+    heuristic_plan(qd)
+    dx = DistributedWCOJExecutor(parts, stats=stats, pool=pool)
+    dx.execute(qd)
+    assert qd.result.status_code == ErrorCode.SUCCESS
+    assert qd.join_dist == {"slices": 4}
+    assert _heavy_submitted(pool) >= before + 3  # slices 1..3 fanned out
+    assert rows_of(qd) == rows_of(q1) == rows_of(qw)
+    a = np.asarray(sorted(rows_of(q1)), dtype=np.int64)
+    b = np.asarray(sorted(rows_of(qd)), dtype=np.int64)
+    assert np.array_equal(a, b)  # byte-identical gathered rows
+    # merged per-level stats cover every level with slice attribution
+    assert all(lv.get("slices") == 4 for lv in qd.join_stats)
+
+
+@pytest.mark.chaos
+def test_dist_join_slice_fault_degrades_per_slice(dist_world):
+    """An injected ``join.slice`` transient fails ONE slice; the gather
+    barrier re-runs it inline (per-slice fallback) and the query still
+    succeeds with byte-identical rows — never a per-query failure."""
+    from wukong_tpu.join.dist import DistributedWCOJExecutor
+
+    g1, parts, stats, meta, pool = dist_world
+    q1 = mkq(meta)
+    heuristic_plan(q1)
+    WCOJExecutor(g1, stats=stats).execute(q1)
+    faults.install(FaultPlan(
+        [FaultSpec(site="join.slice", kind="transient", count=1)], seed=5))
+    qd = mkq(meta)
+    heuristic_plan(qd)
+    dx = DistributedWCOJExecutor(parts, stats=stats, pool=pool)
+    dx.execute(qd)
+    faults.clear()
+    assert qd.result.status_code == ErrorCode.SUCCESS
+    assert rows_of(qd) == rows_of(q1)
+    assert _dist_fallbacks("slice_retry") >= 1
+
+
+def _dist_fallbacks(reason: str) -> float:
+    from wukong_tpu.obs.metrics import get_registry
+
+    for s in get_registry().snapshot().get(
+            "wukong_join_dist_fallback_total", {}).get("series", []):
+        if s["labels"].get("reason") == reason:
+            return s["value"]
+    return 0.0
+
+
+@pytest.mark.chaos
+def test_dist_join_double_slice_failure_degrades_to_walk(dist_world):
+    """A slice that fails its inline retry too degrades the WHOLE query
+    to the (distributed) walk through the proxy's strategy router — the
+    wcoj->walk posture, reply SUCCESS, rows intact."""
+    from wukong_tpu.join.dist import DistributedWCOJExecutor
+    from wukong_tpu.runtime.proxy import Proxy
+
+    g1, parts, stats, meta, pool = dist_world
+
+    class _FakeDist:
+        """Stands in for the DistEngine in the strategy router: carries
+        the sharded store's partitions and walks on the host engine."""
+
+        class _SS:
+            pass
+
+        def __init__(self):
+            self.sstore = self._SS()
+            self.sstore.stores = parts
+
+        def execute(self, q, from_proxy=True):
+            return CPUEngine(g1).execute(q, from_proxy)
+
+    proxy = Proxy(g1, None, cpu_engine=CPUEngine(g1),
+                  planner=Planner(stats))
+    proxy.dist = _FakeDist()
+    proxy._pool = pool
+    qw = mkq(meta)
+    heuristic_plan(qw)
+    CPUEngine(g1).execute(qw)
+    faults.install(FaultPlan(
+        [FaultSpec(site="join.slice", kind="transient", count=2,
+                   shard=1)], seed=9))
+    q = mkq(meta)
+    heuristic_plan(q)
+    q.join_strategy = "wcoj"
+    proxy._serve_execute(q, proxy.dist)
+    faults.clear()
+    assert q.result.status_code == ErrorCode.SUCCESS
+    assert rows_of(q) == rows_of(qw)
+    assert _fallbacks(proxy) >= 1  # counted as a wcoj->walk degradation
+
+
+def test_dist_join_no_pool_runs_single(dist_world):
+    """Without live engines the fan-out degrades to the single federated
+    join (mode=single), not to an error."""
+    from wukong_tpu.join.dist import DistributedWCOJExecutor
+
+    g1, parts, stats, meta, _pool = dist_world
+    q1 = mkq(meta)
+    heuristic_plan(q1)
+    WCOJExecutor(g1, stats=stats).execute(q1)
+    qd = mkq(meta)
+    heuristic_plan(qd)
+    dx = DistributedWCOJExecutor(parts, stats=stats, pool=None)
+    dx.execute(qd)
+    assert qd.result.status_code == ErrorCode.SUCCESS
+    assert rows_of(qd) == rows_of(q1)
+    assert getattr(qd, "join_dist", None) is None  # no fan-out happened
+
+
+def test_sharded_join_view_version_tracks_all_shards(dist_world):
+    """Any shard's mutation bumps the federated view's version, AND a
+    wholesale shard-slot replacement (migration cutover / recovery
+    rebuild assigns ``stores[i] = new_store`` in place) changes it too —
+    the shared table cache must never serve a retired shard's data."""
+    from wukong_tpu.join.dist import ShardedJoinView
+    from wukong_tpu.store.dynamic import insert_triples
+    from wukong_tpu.store.gstore import build_partition
+    from wukong_tpu.types import NORMAL_ID_START
+
+    _g1, parts, _stats, meta, _pool = dist_world
+    live = list(parts)  # stands in for sstore.stores (held by reference)
+    view = ShardedJoinView(live)
+    v0 = view.version
+    a = NORMAL_ID_START + 9001
+    insert_triples(live[2], np.asarray([[a, 2, a + 1]], dtype=np.int64))
+    v1 = view.version
+    assert v1 != v0
+    # slot replacement: a fresh store object in the SAME list slot (the
+    # PR 12 cutover shape) must change the key even at equal versions
+    triples2, _ = generate_triangle(m=20, noise=1, seed=8)
+    live[1] = build_partition(triples2, 1, 4)
+    assert view.version != v1
+    assert view.stores[1] is live[1]  # reads resolve the live source
+
+
+def test_dist_join_budget_expiry_commits_completed_slices(dist_world):
+    """Structured budget expiry mid-fan-out: the completed slices' rows
+    commit as the partial result (complete=False, structured status) —
+    the base executor's 'expiry commits the prefix built so far'
+    posture, never a silently empty partial."""
+    from wukong_tpu.join.dist import DistributedWCOJExecutor
+
+    g1, parts, stats, meta, pool = dist_world
+    Global.query_budget_rows = 200  # each slice charges the shared budget
+    try:
+        qd = mkq(meta)
+        heuristic_plan(qd)
+        dx = DistributedWCOJExecutor(parts, stats=stats, pool=pool)
+        from wukong_tpu.runtime.resilience import Deadline
+
+        qd.deadline = Deadline.from_config()
+        dx.execute(qd)
+    finally:
+        Global.query_budget_rows = 0
+    assert qd.result.status_code == ErrorCode.BUDGET_EXCEEDED
+    assert not qd.result.complete
+
+
 def test_join_gate_requires_readme_knob_row(tmp_path):
     from wukong_tpu.analysis import run_analysis
 
@@ -540,5 +1041,70 @@ def test_join_gate_requires_readme_knob_row(tmp_path):
     assert len(bad) == 1 and "join_strategy" in bad[0].message
     readme.write_text(
         "| knob | default |\n|---|---|\n| `join_strategy` | auto |\n")
+    assert run_analysis(pkg, plugins=["join-strategy"],
+                        readme_path=str(readme)) == []
+
+
+GATE_ROUTES = GATE_GOOD + '\nJOIN_ROUTES = ("host", "device")\n'
+GATE_ROUTE_CHOOSER_OK = """
+def choose_join_route(patterns):
+    if not patterns:
+        return "host"
+    return "device"
+"""
+GATE_ROUTE_CHOOSER_BAD = """
+def classify_join_route(q):
+    return "gpu"
+"""
+
+
+def test_join_gate_route_chooser_needs_registry(tmp_path):
+    """A route chooser without a literal JOIN_ROUTES registry is a
+    violation — the closed set must exist before anything returns from
+    it."""
+    from wukong_tpu.analysis import run_analysis
+
+    pkg = _write_tree(tmp_path / "pkg", {
+        "join/__init__.py": GATE_GOOD,  # strategies only, no routes
+        "planner/opt.py": GATE_ROUTE_CHOOSER_OK,
+    })
+    bad = run_analysis(pkg, plugins=["join-strategy"])
+    assert len(bad) == 1 and "JOIN_ROUTES" in bad[0].message
+
+
+def test_join_gate_flags_undeclared_route(tmp_path):
+    from wukong_tpu.analysis import run_analysis
+
+    pkg = _write_tree(tmp_path / "pkg", {
+        "join/__init__.py": GATE_ROUTES,
+        "planner/opt.py": GATE_ROUTE_CHOOSER_BAD,
+    })
+    bad = run_analysis(pkg, plugins=["join-strategy"])
+    assert len(bad) == 1 and "gpu" in bad[0].message
+    pkg2 = _write_tree(tmp_path / "pkg2", {
+        "join/__init__.py": GATE_ROUTES,
+        "planner/opt.py": GATE_ROUTE_CHOOSER_OK,
+    })
+    assert run_analysis(pkg2, plugins=["join-strategy"]) == []
+
+
+def test_join_gate_requires_join_device_knob_row(tmp_path):
+    """Config-readme coverage both ways: with routes declared, the
+    README knob table must carry the `join_device` row next to
+    `join_strategy` (and is clean once both exist)."""
+    from wukong_tpu.analysis import run_analysis
+
+    pkg = _write_tree(tmp_path / "pkg", {
+        "join/__init__.py": GATE_ROUTES,
+    })
+    readme = tmp_path / "README.md"
+    readme.write_text(
+        "| knob | default |\n|---|---|\n| `join_strategy` | auto |\n")
+    bad = run_analysis(pkg, plugins=["join-strategy"],
+                       readme_path=str(readme))
+    assert len(bad) == 1 and "join_device" in bad[0].message
+    readme.write_text(
+        "| knob | default |\n|---|---|\n| `join_strategy` | auto |\n"
+        "| `join_device` | auto |\n")
     assert run_analysis(pkg, plugins=["join-strategy"],
                         readme_path=str(readme)) == []

@@ -391,6 +391,25 @@ class GlobalConfig:
     # bounded cache of materialized sorted edge tables / index lists
     # (entries, keyed per store version like the plan cache)
     join_table_cache: int = 64
+    # WCOJ level execution route: host (NumPy kernels), device (force the
+    # XLA path on every level), auto (route device when the estimated
+    # per-level candidate volume amortizes the dispatch cost — see
+    # join_device_min_candidates). Any device-path failure degrades the
+    # level to the host kernels, mirroring the wcoj->walk posture.
+    join_device: str = "auto"
+    # dispatch-amortization threshold: under `auto`, the device route is
+    # chosen only when the estimated candidate volume reaches this many
+    # rows, and a level probes on-device only past it (a padded XLA
+    # dispatch costs ~ms; small levels are cheaper on the host kernels).
+    # The measured-candidate feedback demotes templates that routed
+    # device on an over-predicted estimate back to host.
+    join_device_min_candidates: int = 65536
+    # distributed generic join: max slice-range parts a cyclic query over
+    # a sharded store fans out to on the heavy lane (hash-partitioning
+    # the first eliminated variable); bounded by the shard count and the
+    # pool's live engines. 1 disables the fan-out (single-engine wcoj
+    # over the federated view).
+    join_dist_parts: int = 4
 
     # ---- TPU-engine knobs (new; no reference analogue) ----
     table_capacity_min: int = 1024  # smallest binding-table capacity class

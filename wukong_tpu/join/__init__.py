@@ -37,4 +37,11 @@ from __future__ import annotations
 #: build failure, not a silent mis-route.
 JOIN_STRATEGIES = ("walk", "wcoj")
 
-__all__ = ["JOIN_STRATEGIES"]
+#: THE closed set of level-execution routes for the wcoj strategy: the
+#: NumPy host kernels, or the XLA device path (padded/bucketed candidate
+#: tensors through ``kernels.jit_level_probe``). Every string-literal
+#: return of ``choose_join_route``/``classify_join_route`` must be a
+#: member — enforced statically by the same ``join-strategy`` gate.
+JOIN_ROUTES = ("host", "device")
+
+__all__ = ["JOIN_STRATEGIES", "JOIN_ROUTES"]

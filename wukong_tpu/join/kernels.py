@@ -89,24 +89,35 @@ def expand_ragged(start: np.ndarray, deg: np.ndarray):
     return row_idx, start[row_idx] + local
 
 
-def pair_member(keys, offsets, edges, anchors, vals, xp=np):
+def pair_member(keys, offsets, edges, anchors, vals, xp=np, depth=None):
     """Boolean mask: does edge (anchors[i] -> vals[i]) exist in the CSR?
 
     Branchless lower_bound over each row's sorted [start, end) edge range,
     iterated a FIXED ``log2(len(edges))+1`` times so the loop unrolls
     statically under XLA tracing (the host pays the same bound — a no-op
-    once every row's range has converged).
+    once every row's range has converged). ``depth`` overrides the
+    iteration count: each row's range is ONE key's edge run, so
+    ``log2(max_degree)+1`` converges every row — the device path passes
+    the segment's cached degree bound and cuts the dominant per-iteration
+    gather cost by the log(len(edges))/log(max_degree) ratio.
     """
     ne = int(edges.shape[0])
     if ne == 0:
         return xp.zeros(anchors.shape[0], dtype=bool)
     start, deg = lookup_ranges(keys, offsets, anchors, xp=xp)
-    lo = start.astype(np.int64)
-    end = (start + deg).astype(np.int64)
+    # int64 search cursors on the host; under an xp=jnp trace the inputs'
+    # own dtype rules (int32 by default, int64 under enable_x64) — an
+    # unconditional astype would fight the x64-off config every trace
+    lo = start.astype(np.int64) if xp is np else start
+    end = (start + deg) if xp is not np else (start + deg).astype(np.int64)
     hi = end
-    for _ in range(ne.bit_length() + 1):
+    iters = ne.bit_length() + 1 if depth is None else max(int(depth), 1)
+    for _ in range(iters):
         active = lo < hi
-        mid = (lo + hi) // 2
+        # lo + (hi - lo) // 2, NOT (lo + hi) // 2: the device route runs
+        # int32, and lo + hi overflows past 2^30 edges, mis-converging
+        # the search (the classic binary-search midpoint bug)
+        mid = lo + (hi - lo) // 2
         mv = edges[xp.clip(mid, 0, ne - 1)]
         less = mv < vals
         lo = xp.where(active & less, mid + 1, lo)
@@ -124,3 +135,148 @@ def jit_kernels():
     member = jax.jit(lambda s, v: member_sorted(s, v, xp=jnp))
     pair = jax.jit(lambda k, o, e, a, v: pair_member(k, o, e, a, v, xp=jnp))
     return member, pair
+
+
+# ---------------------------------------------------------------------------
+# the device level path: padded/bucketed candidate tensors through XLA
+# ---------------------------------------------------------------------------
+
+#: smallest padded capacity class — tiny dispatches all share one compile
+PAD_FLOOR = 1024
+
+
+def pad_pow2(n: int, floor: int = PAD_FLOOR) -> int:
+    """The device path's capacity class: smallest power of two >=
+    max(n, floor). Candidate tensors are padded to it so the jitted level
+    probe compiles a bounded set of shape variants instead of one per
+    level size (the engine's table-capacity-class discipline)."""
+    c = max(int(n), int(floor), 1)
+    return 1 << (c - 1).bit_length()
+
+
+class DeviceRangeError(ValueError):
+    """An array holds values outside int32 — the device path (which runs
+    int32 under the default x64-off JAX config) must degrade to host
+    rather than silently truncate ids or offsets."""
+
+
+def to_device_i32(arr):
+    """Host int array -> device int32 array, REFUSING (DeviceRangeError)
+    any value outside int32 range instead of truncating. Offsets past
+    2^31 (a >2G-edge segment) and out-of-range ids therefore degrade the
+    query to the host kernels, never to wrong answers; the parity tests
+    drive the same kernels in int64 under ``jax.experimental.enable_x64``
+    to pin >2^31-safe behavior when 64-bit mode is on."""
+    import jax.numpy as jnp
+
+    a = np.asarray(arr)
+    if len(a) and a.dtype != np.int32:
+        # offsets are monotone (last element is the max), id arrays need
+        # the real extrema — one pass, paid once per cached table build
+        lo, hi = int(a.min()), int(a.max())
+        if lo < -(1 << 31) or hi >= (1 << 31):
+            raise DeviceRangeError(
+                f"values [{lo}, {hi}] exceed int32 — host route required")
+    return jnp.asarray(a.astype(np.int32, copy=False))
+
+
+# jitted level-probe variants keyed on (per-adjacency depths, has_glob):
+# the candidate tensor shape is handled by pad_pow2 bucketing, so the
+# cache stays small
+_LEVEL_PROBE_CACHE: dict = {}
+
+
+def jit_level_probe(adj_depths: tuple, has_glob: bool):
+    """The fused XLA probe for one WCOJ generator group: a padded flat
+    candidate tensor is masked by every LISTED constraint in one compiled
+    call — global sorted-list membership plus one ragged pair probe per
+    adjacency — instead of one NumPy pass per constraint with
+    materialized intermediates (where the host path pays its
+    per-candidate cost). The caller lists only the constraints the group
+    actually needs (a generator's self-probe is true by construction and
+    is elided), and ``adj_depths[j]`` is adjacency j's binary-search
+    iteration bound (log2(max_degree)+1, cached with its device table).
+
+    Signature of the returned fn:
+        fn(valid, cand, glob, k0, o0, e0, a0, k1, o1, e1, a1, ...) -> mask
+    where ``valid``/``cand`` are the padded candidate tensor and its
+    validity mask, ``glob`` the intersected global candidate list (ignored
+    when has_glob is False — pass a 1-element dummy), and each adjacency
+    contributes (keys, offsets, edges, anchors)."""
+    import jax
+    import jax.numpy as jnp
+
+    key = (tuple(int(d) for d in adj_depths), bool(has_glob))
+    fn = _LEVEL_PROBE_CACHE.get(key)
+    if fn is not None:
+        return fn
+    depths = key[0]
+
+    def probe(valid, cand, glob, *adj):
+        mask = valid
+        if has_glob:
+            mask = mask & member_sorted(glob, cand, xp=jnp)
+        for j, depth in enumerate(depths):
+            keys, offsets, edges, anchors = adj[4 * j: 4 * j + 4]
+            mask = mask & pair_member(keys, offsets, edges, anchors, cand,
+                                      xp=jnp, depth=depth)
+        return mask
+
+    fn = jax.jit(probe)
+    _LEVEL_PROBE_CACHE[key] = fn
+    return fn
+
+
+def level_probe_host(valid, cand, glob, *adj):
+    """NumPy twin of the jitted level probe (same argument layout) — the
+    parity tests compare the two directly on padded tensors, including
+    all-padding buckets and empty candidate lists."""
+    mask = np.asarray(valid).copy()
+    if glob is not None:
+        mask &= member_sorted(np.asarray(glob), np.asarray(cand))
+    for j in range(len(adj) // 4):
+        keys, offsets, edges, anchors = adj[4 * j: 4 * j + 4]
+        mask &= pair_member(np.asarray(keys), np.asarray(offsets),
+                            np.asarray(edges), np.asarray(anchors),
+                            np.asarray(cand))
+    return mask
+
+
+def seed_masks(s, p, o, tp, ts, to, eq, xp=np):
+    """Every semi-naive term's frontier row mask over an epoch batch
+    (stream/continuous.py), [T, N]: triples [N] columns against per-term
+    specs [T] (predicate, subject-const, object-const, repeated-var
+    equality; -1 = wildcard endpoint). Written against the swappable
+    array module like every kernel here — the SAME function is the host
+    parity oracle and the jitted device path, so the twins cannot
+    drift."""
+    m = p[None, :] == tp[:, None]
+    m &= (ts[:, None] < 0) | (s[None, :] == ts[:, None])
+    m &= (to[:, None] < 0) | (o[None, :] == to[:, None])
+    m &= (~eq[:, None]) | (s[None, :] == o[None, :])
+    return m
+
+
+def seed_masks_host(s, p, o, tp, ts, to, eq) -> np.ndarray:
+    """NumPy instance of :func:`seed_masks` (the parity oracle)."""
+    return seed_masks(s, p, o, tp, ts, to, eq, xp=np)
+
+
+_SEED_MASK_FN = None
+
+
+def jit_seed_masks():
+    """jax.jit-wrapped :func:`seed_masks` — the fused device call. N and
+    T are padded to capacity classes by the caller (pad_pow2, the level
+    probe's padded/bucketed discipline) so large epochs share a handful
+    of compiles."""
+    global _SEED_MASK_FN
+    if _SEED_MASK_FN is not None:
+        return _SEED_MASK_FN
+    import jax
+    import jax.numpy as jnp
+
+    _SEED_MASK_FN = jax.jit(
+        lambda s, p, o, tp, ts, to, eq: seed_masks(s, p, o, tp, ts, to,
+                                                   eq, xp=jnp))
+    return _SEED_MASK_FN

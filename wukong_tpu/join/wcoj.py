@@ -19,6 +19,17 @@ degrades the query to the walk, never to an error.
 Resilience parity with the walk: the per-query deadline is checked and the
 row budget charged at every level; expiry commits the prefix built so far
 as a structured partial result (``result.complete = False``).
+
+Level routes (``join_device`` knob, ROADMAP item 6i): each level's probe
+phase — the per-candidate intersection cost TrieJax moves on-accelerator —
+runs either on the NumPy host kernels or as ONE fused XLA dispatch over a
+padded/bucketed flat candidate tensor (``kernels.jit_level_probe``), with
+device-resident int32 copies of the sorted tables cached per store version
+next to their host twins. The two routes are byte-identical by
+construction (same candidate enumeration, same mask semantics); any
+device-path failure (missing jax, int32 range overflow, a bug) degrades
+the level to the host kernels and latches host for the rest of the query —
+the same degrade-don't-error posture as the wcoj->walk fallback.
 """
 
 from __future__ import annotations
@@ -30,11 +41,15 @@ import numpy as np
 from wukong_tpu.analysis.lockdep import declare_leaf, make_lock
 from wukong_tpu.config import Global
 from wukong_tpu.join.kernels import (
+    DeviceRangeError,
     expand_ragged,
     intersect_many,
+    jit_level_probe,
     lookup_ranges,
     member_sorted,
+    pad_pow2,
     pair_member,
+    to_device_i32,
 )
 from wukong_tpu.join.qgraph import U_CONST, U_PINDEX, U_TYPE, analyze
 from wukong_tpu.obs.metrics import get_registry
@@ -58,6 +73,21 @@ from wukong_tpu.utils.timer import get_usec
 _M_MATERIALIZE = get_registry().counter(
     "wukong_join_materialize_total",
     "WCOJ sorted-edge-table cache requests", labels=("outcome",))
+# device-route observability (README metrics table): which route each
+# level's probe phase actually took, why device levels degraded to host,
+# and the per-dispatch candidate volume (the dispatch-amortization
+# feedback loop behind join_device_min_candidates)
+_M_DEVICE_LEVELS = get_registry().counter(
+    "wukong_join_device_levels_total",
+    "WCOJ level probe phases by executed route", labels=("route",))
+_M_DEVICE_FALLBACK = get_registry().counter(
+    "wukong_join_device_fallback_total",
+    "Device-route levels degraded to the host kernels", labels=("reason",))
+_M_DEVICE_CAND = get_registry().histogram(
+    "wukong_join_device_candidates",
+    "Candidates per device-probed level",
+    buckets=(1 << 10, 1 << 12, 1 << 14, 1 << 16, 1 << 18, 1 << 20,
+             1 << 22, 1 << 24))
 
 # the cache lock guards pure dict moves (materialization happens outside
 # it); nothing is ever acquired under it
@@ -155,6 +185,30 @@ class JoinTableCache:
         # per-const keys would churn the bounded cache under template mixes
         return np.asarray(self.segment(pid, d).lookup(const), dtype=np.int64)
 
+    def device_tables(self, pid: int, d: int):
+        """The (pid, dir) adjacency as device-resident int32 arrays
+        (keys, offsets, edges, depth) for the XLA level probe — built from
+        the verified-sorted host segment and cached per store version like
+        every other entry, so mutations self-invalidate and steady-state
+        device levels never re-ship tables. ``depth`` is the segment's
+        binary-search iteration bound (log2(max_degree)+1 — a probe range
+        is one key's edge run, never the whole edge array). Raises
+        :class:`DeviceRangeError` (caller degrades to host) when any
+        value exceeds int32 under the default x64-off JAX config."""
+        key = (self._version(), "dseg", int(pid), int(d))
+        hit = self._get(key)
+        if hit is not None:
+            _M_MATERIALIZE.labels(outcome="hit").inc()
+            return hit
+        _M_MATERIALIZE.labels(outcome="miss").inc()
+        seg = self.segment(pid, d)  # host twin first (verify + fault site)
+        max_deg = (int(np.diff(seg.offsets).max())
+                   if len(seg.offsets) > 1 else 0)
+        return self._put(key, (to_device_i32(seg.keys),
+                               to_device_i32(seg.offsets),
+                               to_device_i32(seg.edges),
+                               max(max_deg, 1).bit_length() + 1))
+
     def clear(self) -> None:
         with self._lock:
             self._tables.clear()
@@ -174,11 +228,20 @@ class WCOJExecutor:
     can never drift between strategies.
     """
 
-    def __init__(self, gstore, str_server=None, stats=None):
+    def __init__(self, gstore, str_server=None, stats=None, tables=None,
+                 part=None):
         self.g = gstore
         self.str_server = str_server
         self.stats = stats
-        self.tables = JoinTableCache(gstore)
+        # ``tables`` lets the distributed executor share ONE materialized
+        # cache across its per-partition slices (join/dist.py)
+        self.tables = tables if tables is not None else JoinTableCache(gstore)
+        # ``part`` = (S, k): keep only level-0 candidates whose hash lands
+        # in partition k of S — the distributed generic join's split of
+        # the first eliminated variable. Later levels are untouched, so
+        # the union over k of the S partitioned runs is exactly the
+        # unpartitioned result (level-0 values partition the rows).
+        self.part = part
 
     # ------------------------------------------------------------------
     def execute(self, q, from_proxy: bool = True):
@@ -230,6 +293,15 @@ class WCOJExecutor:
         success or on a structured deadline/budget expiry (partial prefix);
         any other failure leaves ``q`` untouched so the caller can degrade
         to the walk."""
+        qg, unary_lists = self._analyze_and_warm(q)
+        self._run_levels(q, qg, unary_lists)
+
+    def _analyze_and_warm(self, q):
+        """Shape checks + up-front materialization of every backing array.
+        The ``join.materialize`` fault site fires here, before ``q`` is
+        touched — and before the distributed executor fans slices out, so
+        a materialization failure degrades the whole query to the walk
+        instead of failing mid-gather."""
         pg = q.pattern_group
         if pg.unions or pg.optional:
             raise WukongError(ErrorCode.UNSUPPORTED_SHAPE,
@@ -240,8 +312,6 @@ class WCOJExecutor:
             raise WukongError(ErrorCode.UNSUPPORTED_SHAPE,
                               f"wcoj: {qg.reason}")
 
-        # resolve every constraint's backing array up-front: the
-        # join.materialize fault site fires here, before q is touched
         unary_lists: dict[int, list] = {v: [] for v in qg.order}
         for u in qg.unaries:
             if u.kind == U_TYPE:
@@ -262,7 +332,11 @@ class WCOJExecutor:
             self.tables.segment(e.pid, OUT if later_is_o else IN)
             earlier = e.s if later_is_o else e.o
             self.tables.index_list(e.pid, IN if earlier == e.s else OUT)
+        return qg, unary_lists
 
+    def _run_levels(self, q, qg, unary_lists) -> None:
+        """The level loop over an analyzed, warmed query graph."""
+        route = self._route_for(q)
         prefix = np.empty((1, 0), dtype=np.int64)
         cols: dict[int, int] = {}
         levels: list[dict] = []
@@ -272,7 +346,7 @@ class WCOJExecutor:
                 t0 = get_usec()
                 rows_in = len(prefix)
                 prefix, rec = self._level(qg, v, k, prefix, cols,
-                                          unary_lists[v])
+                                          unary_lists[v], route, q)
                 cols[v] = k
                 rec.update(level=k, var=v, rows_in=rows_in,
                            rows_out=len(prefix),
@@ -287,8 +361,33 @@ class WCOJExecutor:
         self._commit(q, prefix, cols, levels, partial=False)
 
     # ------------------------------------------------------------------
+    # level routing (join_device knob; JOIN_ROUTES registry)
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _route_for(q) -> str:
+        """The query's level route: the proxy's plan-time classification
+        (``q.join_route``) when present, else the forced knob — a bare
+        executor under ``auto`` stays on host (it has no cost model to
+        amortize the dispatch against)."""
+        r = getattr(q, "join_route", None)
+        if r is not None:
+            return r
+        knob = str(Global.join_device).strip().lower()
+        return "device" if knob == "device" else "host"
+
+    @staticmethod
+    def _device_floor() -> int:
+        """Per-level candidate floor for the device probe. A forced
+        ``join_device device`` probes every level (deterministic tests);
+        under auto-routing, levels below the dispatch-amortization
+        threshold keep the host kernels."""
+        if str(Global.join_device).strip().lower() == "device":
+            return 1
+        return max(int(Global.join_device_min_candidates), 1)
+
+    # ------------------------------------------------------------------
     def _level(self, qg, v: int, k: int, prefix: np.ndarray,
-               cols: dict, unary: list):
+               cols: dict, unary: list, route: str = "host", q=None):
         """Materialize variable ``v`` against the bound prefix.
 
         Generator choice is PER ROW: each prefix row expands from its
@@ -300,14 +399,15 @@ class WCOJExecutor:
         self-probe is redundant but always true). Returns the new prefix
         and the level's intersection stats.
         """
-        adj = []  # (anchor col, segment) — other endpoint already bound
+        adj = []  # (anchor col, pid, dir, segment) — other endpoint bound
         glob = list(unary)  # global sorted candidate lists
         for e in qg.edges_of(v):
             v_is_o = e.o == v
             other = e.s if v_is_o else e.o
             if other in cols:
-                seg = self.tables.segment(e.pid, OUT if v_is_o else IN)
-                adj.append((cols[other], seg))
+                d = OUT if v_is_o else IN
+                seg = self.tables.segment(e.pid, d)
+                adj.append((cols[other], e.pid, d, seg))
             else:
                 glob.append(self.tables.index_list(
                     e.pid, IN if e.s == v else OUT))
@@ -321,7 +421,7 @@ class WCOJExecutor:
         # per-row generator: argmin over each adjacency's degree and the
         # global list's (constant) length
         ranges = [lookup_ranges(seg.keys, seg.offsets, prefix[:, c])
-                  for c, seg in adj]
+                  for c, _pid, _d, seg in adj]
         deg_stack = [d for (_s, d) in ranges]
         if G is not None:
             deg_stack.append(np.full(n, len(G), dtype=np.int64))
@@ -330,42 +430,147 @@ class WCOJExecutor:
         choice = np.argmin(degs, axis=0) if n else \
             np.empty(0, dtype=np.int64)
 
-        parts = []  # (row_idx, newcol) per generator group
+        parts = []  # (generator id, row_idx, newcol) per generator group
         for j, (start, deg) in enumerate(ranges):
             rows = np.nonzero(choice == j)[0]
             if len(rows) == 0:
                 continue
             row_idx, pos = expand_ragged(start[rows], deg[rows])
-            parts.append((rows[row_idx], adj[j][1].edges[pos]))
+            parts.append((j, rows[row_idx], adj[j][3].edges[pos]))
         if G is not None:
             rows = np.nonzero(choice == len(ranges))[0]
             if len(rows):
-                parts.append((np.repeat(rows, len(G)),
+                parts.append((len(adj), np.repeat(rows, len(G)),
                               np.tile(G, len(rows))))
         if parts:
-            row_idx = np.concatenate([p[0] for p in parts])
-            newcol = np.concatenate([p[1] for p in parts]).astype(
+            row_idx = np.concatenate([p[1] for p in parts])
+            newcol = np.concatenate([p[2] for p in parts]).astype(
                 np.int64, copy=False)
+            # which generator produced each candidate (non-decreasing by
+            # construction — groups are appended in generator order), so
+            # the device path can elide each group's always-true
+            # self-probe and slice groups as contiguous ranges. Only the
+            # device route consumes it — the host route skips the alloc
+            gid = (np.concatenate([np.full(len(p[1]), p[0],
+                                           dtype=np.int16) for p in parts])
+                   if route == "device" else None)
         else:
             row_idx = np.empty(0, dtype=np.int64)
             newcol = np.empty(0, dtype=np.int64)
+            gid = np.empty(0, dtype=np.int16) if route == "device" else None
+
+        if self.part is not None and k == 0 and len(newcol):
+            # distributed generic join: this slice keeps only its hash
+            # partition of the first eliminated variable's candidates —
+            # BEFORE the probes, so the fan-out divides the probe work
+            S, kk = self.part
+            from wukong_tpu.utils.mathutil import hash_mod
+
+            pm = hash_mod(newcol.astype(np.int32), S) == kk
+            row_idx, newcol = row_idx[pm], newcol[pm]
+            if gid is not None:
+                gid = gid[pm]
 
         candidates = len(newcol)
-        probes = 0
+        probes = len(adj) + (1 if G is not None else 0)
+        lvl_route = "host"
         if len(newcol):
-            mask = np.ones(len(newcol), dtype=bool)
-            if G is not None:
-                probes += 1
-                mask &= member_sorted(G, newcol)
-            for c, seg in adj:
-                probes += 1
-                anchors = prefix[row_idx, c]
-                mask &= pair_member(seg.keys, seg.offsets, seg.edges,
-                                    anchors, newcol)
+            mask = None
+            if route == "device" and candidates >= self._device_floor() \
+                    and not (q is not None
+                             and getattr(q, "_join_device_broken", False)):
+                try:
+                    mask = self._probe_device(G, adj, prefix, row_idx,
+                                              newcol, gid)
+                    lvl_route = "device"
+                except Exception as e:
+                    # degrade THIS query's remaining levels to host (the
+                    # wcoj->walk posture, one layer down); the host probe
+                    # below serves this level
+                    reason = (type(e).__name__ if not isinstance(
+                        e, DeviceRangeError) else "int32_range")
+                    _M_DEVICE_FALLBACK.labels(reason=reason).inc()
+                    if q is not None:
+                        q._join_device_broken = True
+            if mask is None:
+                mask = np.ones(len(newcol), dtype=bool)
+                if G is not None:
+                    mask &= member_sorted(G, newcol)
+                for c, _pid, _d, seg in adj:
+                    anchors = prefix[row_idx, c]
+                    mask &= pair_member(seg.keys, seg.offsets, seg.edges,
+                                        anchors, newcol)
             row_idx, newcol = row_idx[mask], newcol[mask]
+        _M_DEVICE_LEVELS.labels(route=lvl_route).inc()
         new_prefix = np.column_stack(
             [prefix[row_idx], newcol]).astype(np.int64, copy=False)
-        return new_prefix, {"candidates": candidates, "probes": probes}
+        return new_prefix, {"candidates": candidates, "probes": probes,
+                            "route": lvl_route}
+
+    # ------------------------------------------------------------------
+    def _probe_device(self, G, adj, prefix: np.ndarray, row_idx: np.ndarray,
+                      newcol: np.ndarray, gid: np.ndarray) -> np.ndarray:
+        """The level's probe phase as one fused XLA dispatch per generator
+        group: each group's padded flat candidate tensor is masked by
+        every constraint EXCEPT its own generator (whose self-probe is
+        true by construction — candidates were drawn from that list), the
+        adjacencies ship as cached device-resident tables with their
+        binary-search depth bounds, and the global list ships per level
+        (it is an intersection result, not a cacheable table). Candidate
+        tensors are padded to power-of-two capacity classes so the jit
+        variants stay bounded. Returns the host boolean mask over the
+        unpadded candidates — identical semantics to the host probes.
+        """
+        import jax.numpy as jnp
+
+        _M_DEVICE_CAND.observe(len(newcol))
+        dev = [self.tables.device_tables(pid, d)
+               for (_c, pid, d, _s) in adj]
+        glob_dev = to_device_i32(G) if G is not None else None
+        dummy = jnp.zeros(1, dtype=jnp.int32)
+        mask = np.zeros(len(newcol), dtype=bool)
+        # gid is non-decreasing by construction: one diff pass finds the
+        # group boundaries (no sort over millions of candidates)
+        bounds = np.flatnonzero(np.diff(gid)) + 1
+        starts = np.concatenate([[0], bounds])
+        ends = np.concatenate([bounds, [len(gid)]])
+        for lo, hi in zip(starts.tolist(), ends.tolist()):
+            g = int(gid[lo])
+            C = hi - lo
+            use_glob = G is not None and g != len(adj)
+            adj_ids = [j for j in range(len(adj)) if j != g]
+            if not adj_ids and not use_glob:
+                mask[lo:hi] = True  # only the self-constraint: all pass
+                continue
+            Cp = pad_pow2(C)
+            valid = np.zeros(Cp, dtype=bool)
+            valid[:C] = True
+            cand = np.zeros(Cp, dtype=np.int32)
+            cand[:C] = newcol[lo:hi]  # ids < 2^31 (tables range-checked)
+            args = [jnp.asarray(valid), jnp.asarray(cand),
+                    glob_dev if use_glob else dummy]
+            depths = []
+            for j in adj_ids:
+                keys, offsets, edges, depth = dev[j]
+                avals = prefix[row_idx[lo:hi], adj[j][0]]
+                if len(avals):
+                    # anchors come from the PREFIX, which host-route
+                    # levels may have bound from never-range-checked host
+                    # tables — an unchecked int32 fill would silently
+                    # wrap ids past 2^31 and alias real keys (the
+                    # degrade-don't-truncate contract, like the tables)
+                    alo, ahi = int(avals.min()), int(avals.max())
+                    if alo < -(1 << 31) or ahi >= (1 << 31):
+                        raise DeviceRangeError(
+                            f"anchor values [{alo}, {ahi}] exceed int32 "
+                            "— host route required")
+                anchors = np.zeros(Cp, dtype=np.int32)
+                anchors[:C] = avals
+                args.extend([keys, offsets, edges, jnp.asarray(anchors)])
+                depths.append(depth)
+            fn = jit_level_probe(tuple(depths), use_glob)
+            mask[lo:hi] = np.asarray(fn(*args))[:C]
+        return mask
 
     # ------------------------------------------------------------------
     def _commit(self, q, prefix: np.ndarray, cols: dict, levels: list,

@@ -233,10 +233,16 @@ def _build_report(q, est: list | None, trace: QueryTrace | None,
         "optional": len(q.pattern_group.optional),
     }
     # tensor-join execution: per-level intersection stats recorded by the
-    # WCOJ executor (variable order, candidate/emitted rows, probe counts)
+    # WCOJ executor (variable order, candidate/emitted rows, probe counts,
+    # and which route — host NumPy or XLA device — probed each level)
     join_stats = getattr(q, "join_stats", None)
     if join_stats:
         report["wcoj_levels"] = join_stats
+    if report["strategy"] == "wcoj":
+        report["route"] = getattr(q, "join_route", "host")
+        dist = getattr(q, "join_dist", None)
+        if dist:
+            report["join_dist"] = dist
     if est is not None:
         report["est_total_cost"] = round(est[-1]["est_cost_cum"], 1)
     if trace is not None:
@@ -286,14 +292,22 @@ def _render(report: dict) -> str:
                  f"{report['optional']} optional group(s), planned "
                  "recursively — not estimated here)")
     lines.append(tail)
+    if report.get("route") is not None:
+        # the level-route line: host NumPy kernels vs the XLA device path
+        # (+ the distributed fan-out width when the join was sharded)
+        route_line = f"route: {report['route']}"
+        if report.get("join_dist"):
+            route_line += f" (dist slices={report['join_dist']['slices']})"
+        lines.append(route_line)
     if report.get("wcoj_levels"):
         lines.append(f"{'lvl':>4}  {'var':>6} {'rows_in':>9} "
                      f"{'candidates':>11} {'rows_out':>9} {'probes':>6} "
-                     f"{'time_us':>9}")
+                     f"{'route':>7} {'time_us':>9}")
         for lv in report["wcoj_levels"]:
             lines.append(f"{lv['level']:>4}  {lv['var']:>6} "
                          f"{lv['rows_in']:>9,} {lv['candidates']:>11,} "
                          f"{lv['rows_out']:>9,} {lv['probes']:>6} "
+                         f"{lv.get('route', 'host'):>7} "
                          f"{lv.get('time_us', 0):>9,}")
     if analyze:
         lines.append(f"status: {report['status']} rows={report['rows']:,} "

@@ -525,6 +525,39 @@ class Planner:
             return "wcoj"
         return "walk"
 
+    def choose_join_route(self, patterns: list) -> str:
+        """Pick the wcoj LEVEL route for an already-ordered pattern list.
+
+        ``join_device`` knob: ``host`` forces the NumPy kernels;
+        ``device`` forces the XLA path on every level; ``auto`` (default)
+        routes device only when the estimated candidate volume — the
+        chain's summed per-step output rows, the quantity the per-level
+        probes scale with — reaches ``join_device_min_candidates``, so a
+        padded dispatch is amortized. Unestimable chains stay on host
+        (the dispatch cost is certain, the win is not). Every return
+        value is a member of ``join.JOIN_ROUTES`` (the ``join-strategy``
+        analysis gate holds this statically)."""
+        from wukong_tpu.config import Global
+
+        knob = str(Global.join_device).strip().lower()
+        if knob == "host":
+            return "host"
+        if knob == "device":
+            return "device"
+        try:
+            import importlib.util
+
+            if importlib.util.find_spec("jax") is None:
+                return "host"
+        except Exception:
+            return "host"
+        ests = self.estimate_chain(patterns)
+        if ests is None:
+            return "host"
+        if sum(ests) >= max(int(Global.join_device_min_candidates), 1):
+            return "device"
+        return "host"
+
     def _orient(self, state: _State, p: Pattern) -> Pattern:
         s_var_b = p.subject < 0 and p.subject in state.vars
         pred_var = p.predicate < 0

@@ -31,6 +31,10 @@ Sites instrumented today:
 - ``join.materialize``  — WCOJ sorted-edge-table materialization in
   join/wcoj.py (fires before any result state is touched, so the proxy
   degrades the query to the walk instead of erroring)
+- ``join.slice``        — one hash-partition slice of a distributed join
+  in join/dist.py (``shard`` = slice index; fires before the slice runs,
+  so an injected failure costs one inline retry on the gather thread —
+  per-slice fallback, never a failed query)
 - ``proxy.serve``       — serving-boundary dispatch in runtime/proxy.py
   (fires before any engine dispatch: an injected failure surfaces as a
   client-visible error reply — the SLO-plane chaos scenario's way of
@@ -71,6 +75,11 @@ KNOWN_FAULT_SITES = frozenset({
     "checkpoint.write",    # checkpoint bundle write (runtime/recovery.py)
     "batch.heavy.dispatch",  # fused heavy-lane dispatch (runtime/batcher.py)
     "join.materialize",    # WCOJ sorted-table materialization (join/wcoj.py)
+    "join.slice",          # distributed-join partition slice (join/dist.py;
+                           # fires before the slice touches any state, so an
+                           # injected failure degrades per-slice — one
+                           # inline retry on the gather thread — never
+                           # per-query)
     "proxy.serve",         # serving-boundary dispatch (runtime/proxy.py;
                            # the SLO-plane chaos scenario's injection point)
     "migration.clone",     # shard-migration snapshot (runtime/migration.py)

@@ -96,7 +96,16 @@ class Proxy:
         self._m_join_demoted = self.metrics.counter(
             "wukong_join_demotions_total",
             "Templates demoted wcoj->walk by measured-blowup feedback")
+        # device-route plumbing (join_device knob): plan-time host/device
+        # decisions and the measured-candidate demotions back to host
+        self._m_join_route = self.metrics.counter(
+            "wukong_join_route_total",
+            "Plan-time wcoj level-route decisions", labels=("route",))
+        self._m_route_demoted = self.metrics.counter(
+            "wukong_join_route_demotions_total",
+            "Templates demoted device->host by measured-candidate feedback")
         self._wcoj = None  # guarded by: _batcher_init_lock
+        self._wcoj_dist = None  # guarded by: _batcher_init_lock
         self._pool = None
         self._stream = None
         # serving fast path: parse cache (query text -> parsed query) and
@@ -469,6 +478,9 @@ class Proxy:
         self._m_lane.labels(lane=qq.lane).inc()
         qq.join_strategy = self.classify_join_strategy(qq)
         self._m_join.labels(strategy=qq.join_strategy).inc()
+        if qq.join_strategy == "wcoj":
+            qq.join_route = self.classify_join_route(qq)
+            self._m_join_route.labels(route=qq.join_route).inc()
 
     # ------------------------------------------------------------------
     # tensor-join strategy routing (wukong_tpu/join/)
@@ -500,6 +512,69 @@ class Proxy:
         return self._plan_cache.aux(
             "strategy", sig, (*self._plan_version(), *key_extra),
             lambda: self.planner.choose_strategy(pats))
+
+    def classify_join_route(self, q: SPARQLQuery) -> str:
+        """Plan-time host/device level route for a wcoj-routed query,
+        memoized per template signature + store version like the strategy
+        decision (the knobs join the key so a runtime flip applies
+        immediately). Overwritten by ``_record_route_feedback`` when the
+        measured candidate volume says the estimate over-predicted."""
+        knob = str(Global.join_device).strip().lower()
+        if knob in ("host", "device"):
+            return "device" if knob == "device" else "host"
+        if self.planner is None or not Global.enable_planner:
+            return "host"  # no cost model to amortize the dispatch against
+        sig = template_signature(q)
+        pats = list(q.pattern_group.patterns)
+        key_extra = (knob, int(Global.join_device_min_candidates))
+        return self._plan_cache.aux(
+            "route", sig, (*self._plan_version(), *key_extra),
+            lambda: self.planner.choose_join_route(pats))
+
+    def _route_memo_key(self):
+        return (*self._plan_version(), "auto",
+                int(Global.join_device_min_candidates))
+
+    def _record_route_feedback(self, q: SPARQLQuery) -> None:
+        """Device-route feedback (the PR 10 measured-blowup pattern, one
+        layer down): after a successful wcoj execution that ROUTED device
+        under ``join_device auto``, compare the MEASURED candidate volume
+        (summed per-level candidates from ``q.join_stats``) against the
+        dispatch-amortization threshold and demote the memoized route to
+        host when the estimate over-predicted — the padded dispatches
+        were pure overhead on a chain this small. The memo key mirrors
+        ``classify_join_route``'s exactly, so the demotion takes effect
+        on the very next same-template query, and a knob flip or store
+        mutation re-arms the estimate-driven decision."""
+        stats = getattr(q, "join_stats", None)
+        if (not stats or q.result.status_code != ErrorCode.SUCCESS
+                or getattr(q, "join_route", "host") != "device"
+                or str(Global.join_device).strip().lower() != "auto"
+                or self.planner is None or not Global.enable_planner):
+            return
+        sig = template_signature(q)
+        if sig is None:
+            return
+        if getattr(q, "_join_device_broken", False):
+            # the executor latched host mid-query (DeviceRangeError, a
+            # kernel bug, ...): a deterministic failure would re-pay the
+            # failed device attempt on every same-template query — demote
+            # the memo; a store mutation or knob flip re-arms the attempt
+            self._plan_cache.put_aux("route", sig, self._route_memo_key(),
+                                     "host")
+            self._m_route_demoted.inc()
+            log_info("wcoj device route: template demoted to host "
+                     "(device path failed and latched host)")
+            return
+        measured = sum(int(lv.get("candidates", 0)) for lv in stats)
+        if measured < max(int(Global.join_device_min_candidates), 1):
+            self._plan_cache.put_aux("route", sig, self._route_memo_key(),
+                                     "host")
+            self._m_route_demoted.inc()
+            log_info(f"wcoj device route: template demoted to host "
+                     f"(measured candidates {measured:,} < "
+                     f"join_device_min_candidates "
+                     f"{Global.join_device_min_candidates:,})")
 
     def _record_wcoj_feedback(self, q: SPARQLQuery) -> None:
         """WCOJ auto-routing feedback (PR 9 headroom): after a successful
@@ -563,6 +638,24 @@ class Proxy:
                         self.g, self.str_server,
                         stats=getattr(self.planner, "stats", None))
         return self._wcoj  # unguarded: write-once reference, non-None past init
+
+    def wcoj_dist(self):
+        """Lazily-built DISTRIBUTED WCOJ executor over the sharded
+        store's host partitions: hash-partitions the first eliminated
+        variable and fans the per-partition joins out on the heavy lane
+        (join/dist.py), so a cyclic query on a sharded store no longer
+        funnels through one engine. The pool resolves lazily — slices run
+        inline until the host engine pool exists."""
+        if self._wcoj_dist is None:  # unguarded: double-checked fast path, as wcoj()
+            with self._batcher_init_lock:
+                if self._wcoj_dist is None:
+                    from wukong_tpu.join.dist import DistributedWCOJExecutor
+
+                    self._wcoj_dist = DistributedWCOJExecutor(
+                        self.dist.sstore.stores, self.str_server,
+                        stats=getattr(self.planner, "stats", None),
+                        pool=lambda: self._pool)
+        return self._wcoj_dist  # unguarded: write-once reference, non-None past init
 
     # ------------------------------------------------------------------
     # heavy-lane routing (runtime/batcher.py heavy path)
@@ -669,11 +762,17 @@ class Proxy:
             if served:
                 return q
         try:
-            if getattr(q, "join_strategy", "walk") == "wcoj" and not pinned \
-                    and eng is not self.dist:
+            if getattr(q, "join_strategy", "walk") == "wcoj" and not pinned:
                 try:
-                    self.wcoj().try_execute(q)
+                    # a sharded store routes the DISTRIBUTED join (heavy-
+                    # lane fan-out over the partitions); any failure on
+                    # either executor degrades to the matching walk below
+                    if eng is self.dist and self.dist is not None:
+                        self.wcoj_dist().try_execute(q)
+                    else:
+                        self.wcoj().try_execute(q)
                     self._record_wcoj_feedback(q)
+                    self._record_route_feedback(q)
                     return q
                 except Exception as e:
                     reason = (e.code.name if isinstance(e, WukongError)

@@ -68,6 +68,13 @@ def _emit_breaker_event(kind: str, key) -> None:
     emit_event(kind, shard=shard, key=str(key))
 
 
+# serializes Deadline.charge_rows across threads sharing one deadline
+# (heavy split slices, distributed join partitions); declared leaf —
+# nothing is ever acquired under it
+declare_leaf("resilience.charge")
+_CHARGE_LOCK = make_lock("resilience.charge")
+
+
 class Deadline:
     """Wall-clock deadline + intermediate-row budget for one query."""
 
@@ -101,10 +108,18 @@ class Deadline:
             raise QueryTimeout(where)
 
     def charge_rows(self, n: int, where: str = "") -> None:
-        self.rows_charged += int(n)
-        if self.budget_rows and self.rows_charged > self.budget_rows:
+        # module-level lock, not per-instance: a Deadline may be SHARED
+        # by concurrent chargers (heavy-lane split slices, distributed
+        # join partitions), and a bare += is a lost-update race that
+        # under-enforces the budget; a lock attribute would make queries
+        # carrying deadlines undeepcopyable, so the (nanoseconds-held)
+        # process-wide lock serializes instead
+        with _CHARGE_LOCK:
+            self.rows_charged += int(n)
+            total = self.rows_charged
+        if self.budget_rows and total > self.budget_rows:
             raise BudgetExceeded(
-                f"{self.rows_charged:,} rows > budget "
+                f"{total:,} rows > budget "
                 f"{self.budget_rows:,}" + (f" at {where}" if where else ""))
 
 

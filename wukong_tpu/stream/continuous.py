@@ -62,6 +62,14 @@ STREAM_WAIT_TIMEOUT_S = 60.0
 _M_CB_ERRORS = get_registry().counter(
     "wukong_stream_callback_errors_total",
     "Push-sink callback invocations that raised (contained)")
+# device-batched frontier seeding (ROADMAP follow-up a, device half):
+# outcome=device when one fused XLA call produced every term's row mask,
+# host when the epoch was under the amortization threshold / the knob
+# pinned host, fallback when the device path failed and the per-term
+# NumPy masks served instead
+_M_SEED_BATCH = get_registry().counter(
+    "wukong_stream_seed_batch_total",
+    "Per-epoch frontier seeding by route", labels=("outcome",))
 
 
 @dataclass
@@ -85,23 +93,27 @@ def _triplewise(pat: Pattern) -> tuple[int, int, int]:
     return pat.subject, pat.predicate, pat.object
 
 
-def match_delta(pat: Pattern, triples: np.ndarray):
+def match_delta(pat: Pattern, triples: np.ndarray, row_mask=None):
     """Frontier of one pattern over an epoch batch: (vars, seed_table).
 
     vars lists the pattern's variable endpoints (triple order, deduped);
     seed_table is the [k, len(vars)] distinct bindings drawn from the batch
     rows matching the pattern's constants. Empty batch -> (vars, 0-row).
+    ``row_mask`` supplies a precomputed batch-row match mask (the
+    device-batched seeding path) — the host mask passes are then skipped.
     """
     ts, tp, to = _triplewise(pat)
     s, p, o = triples[:, 0], triples[:, 1], triples[:, 2]
-    mask = p == tp
+    mask = row_mask if row_mask is not None else (p == tp)
     cols = []
     vars_: list[int] = []
     for end, col in ((ts, s), (to, o)):
         if end >= 0:
-            mask = mask & (col == end)
+            if row_mask is None:
+                mask = mask & (col == end)
         elif end in vars_:  # repeated var (?x p ?x): equality, one column
-            mask = mask & (s == o)
+            if row_mask is None:
+                mask = mask & (s == o)
         else:
             vars_.append(end)
             cols.append(col)
@@ -113,6 +125,69 @@ def match_delta(pat: Pattern, triples: np.ndarray):
     if len(seed):
         seed = np.unique(seed, axis=0)
     return vars_, seed
+
+
+def device_seed_masks(patterns: list, triples: np.ndarray, owner=None):
+    """Per-term frontier row masks [T, N] through ONE fused XLA call
+    (join.kernels.jit_seed_masks) — the device half of ROADMAP follow-up
+    (a): a large epoch's T per-term NumPy mask passes collapse into a
+    single padded/bucketed dispatch. Returns None when the epoch is under
+    the ``join_device_min_candidates`` amortization threshold, the
+    ``join_device`` knob pins host, jax is unavailable, or anything in
+    the device path fails — the caller then runs the per-term host masks
+    (byte-identical by the kernel parity tests). A failure LATCHES host
+    on ``owner`` (the ContinuousEngine — the wcoj path's per-query
+    ``_join_device_broken`` posture, per engine here), so a deterministic
+    failure like >int32 ids is paid once, not re-attempted with a warn
+    per epoch. The FRONTIER stays host-partition either way; distributing
+    it is item 6ii headroom."""
+    knob = str(Global.join_device).strip().lower()
+    n = len(triples)
+    if (knob == "host" or not patterns or n == 0
+            or (owner is not None
+                and getattr(owner, "_seed_device_broken", False))
+            or (knob != "device"
+                and n * len(patterns)
+                < max(int(Global.join_device_min_candidates), 1))):
+        _M_SEED_BATCH.labels(outcome="host").inc()
+        return None
+    try:
+        from wukong_tpu.join.kernels import (
+            jit_seed_masks,
+            pad_pow2,
+            to_device_i32,
+        )
+
+        tp = np.empty(len(patterns), dtype=np.int32)
+        ts = np.empty(len(patterns), dtype=np.int32)
+        to = np.empty(len(patterns), dtype=np.int32)
+        eq = np.zeros(len(patterns), dtype=bool)
+        for i, pat in enumerate(patterns):
+            ps, pp, po = _triplewise(pat)
+            tp[i] = pp
+            # -1 marks a wildcard endpoint; a repeated var (?x p ?x) is
+            # the equality flag, matching match_delta's host masks
+            ts[i] = ps if ps >= 0 else -1
+            to[i] = po if po >= 0 else -1
+            eq[i] = ps < 0 and ps == po
+        npad = pad_pow2(n)
+        s = np.full(npad, -1, dtype=np.int64)
+        p = np.full(npad, -1, dtype=np.int64)
+        o = np.full(npad, -1, dtype=np.int64)
+        s[:n], p[:n], o[:n] = triples[:, 0], triples[:, 1], triples[:, 2]
+        fn = jit_seed_masks()
+        masks = np.asarray(fn(
+            to_device_i32(s), to_device_i32(p), to_device_i32(o),
+            to_device_i32(tp), to_device_i32(ts), to_device_i32(to),
+            np.asarray(eq)))[:, :n]
+        _M_SEED_BATCH.labels(outcome="device").inc()
+        return masks
+    except Exception as e:
+        _M_SEED_BATCH.labels(outcome="fallback").inc()
+        if owner is not None:
+            owner._seed_device_broken = True
+        log_warn(f"device seed batching degraded to host masks: {e!r}")
+        return None
 
 
 def _pattern_vars(patterns: list[Pattern]) -> set[int]:
@@ -439,8 +514,11 @@ class ContinuousEngine:
         new_rows: set = set()
         degraded = False
         jobs = []  # (query, term index)
+        masks = device_seed_masks(sq.patterns, triples, owner=self)
         for i, pat in enumerate(sq.patterns):
-            vars_, seed = match_delta(pat, triples)
+            vars_, seed = match_delta(
+                pat, triples,
+                row_mask=masks[i] if masks is not None else None)
             if len(seed) == 0:
                 continue
             q = self._make_delta_query(sq, i, vars_, seed)
@@ -647,8 +725,11 @@ class ContinuousEngine:
         Raises on any term failure — the caller falls back to a full
         refresh rather than trusting an incomplete candidate set."""
         rows: set = set()
+        masks = device_seed_masks(sq.patterns, triples, owner=self)
         for i, pat in enumerate(sq.patterns):
-            vars_, seed = match_delta(pat, triples)
+            vars_, seed = match_delta(
+                pat, triples,
+                row_mask=masks[i] if masks is not None else None)
             if len(seed) == 0:
                 continue
             q = self._make_delta_query(sq, i, vars_, seed)
