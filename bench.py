@@ -536,8 +536,11 @@ def serve_main(device_ok: bool) -> None:
     open-loop workload — closed-loop client threads submitting query TEXTS
     through proxy.serve_query (parse cache -> plan cache -> batcher or
     direct engine). The OFF number is the seed serving path; the ON number
-    coalesces compatible queries into fused chain dispatches. Artifact:
-    BENCH_SERVE.json with both numbers and the speedup."""
+    coalesces compatible queries into fused chain dispatches. Also runs
+    the admission-plane overhead guard (interleaved on/off 2-hop micro —
+    the off knob must be zero-touch; p25..p75 bands must overlap).
+    Artifact: BENCH_SERVE.json with both numbers, the speedup, and the
+    `admission_overhead` detail."""
     import numpy as np
 
     from wukong_tpu.config import Global
@@ -590,6 +593,50 @@ def serve_main(device_ok: bool) -> None:
     occ = snap.get("wukong_batch_occupancy", {}).get("series", [])
     mean_occ = (round(occ[0]["sum"] / occ[0]["count"], 2)
                 if occ and occ[0].get("count") else None)
+
+    # admission-plane overhead guard: the off knob must be zero-touch on
+    # the serving path. Single-threaded 2-hop micro, interleaved
+    # admission-off / admission-on (armed but uncontended: no quotas, no
+    # overload) chunks; the p25..p75 latency bands must overlap — a
+    # disjoint band means the plane taxes the hot path even when idle/off
+    from wukong_tpu.runtime.admission import get_admission
+    from wukong_tpu.utils.timer import get_usec
+
+    two_hop = (f"SELECT ?x ?y WHERE {{ ?x <{UB}advisor> "
+               f"{ss.id2str(int(anchors[0]))} . "
+               f"?x <{UB}memberOf> ?y . }}")
+    for _ in range(30):  # warm the 2-hop parse/plan/engine shapes
+        proxy.serve_query(two_hop, blind=True)
+    lat = {"off": [], "on": []}
+    prev_adm = Global.enable_admission
+    get_admission().reset()
+    try:
+        for _round in range(30):
+            for mode in ("off", "on"):
+                Global.enable_admission = mode == "on"
+                for _ in range(10):
+                    t0 = get_usec()
+                    proxy.serve_query(two_hop, blind=True)
+                    lat[mode].append(get_usec() - t0)
+    finally:
+        Global.enable_admission = prev_adm
+        get_admission().reset()
+
+    def band(xs: list) -> dict:
+        xs = sorted(xs)
+        return {"p25_us": int(xs[len(xs) // 4]),
+                "p50_us": int(xs[len(xs) // 2]),
+                "p75_us": int(xs[(3 * len(xs)) // 4])}
+
+    b_off, b_on = band(lat["off"]), band(lat["on"])
+    bands_overlap = (b_off["p25_us"] <= b_on["p75_us"]
+                     and b_on["p25_us"] <= b_off["p75_us"])
+    admission_overhead = {
+        "query": "2-hop chain micro, single-threaded, interleaved",
+        "samples_per_mode": len(lat["off"]),
+        "off": b_off, "on": b_on,
+        "bands_overlap": bands_overlap,
+    }
     _emit_final({
         "metric": f"LUBM-{scale} serving-path throughput, {clients} clients "
                   f"x {dur:.0f}s same-template closed loop "
@@ -607,9 +654,17 @@ def serve_main(device_ok: bool) -> None:
                       "clients": clients, "scale": scale},
             "mean_batch_occupancy": mean_occ,
             "batch_metrics": batch_metrics,
+            "admission_overhead": admission_overhead,
             "dataset": DATASET_NOTES["lubm"],
         },
     }, "BENCH_SERVE.json")
+    # overhead guard self-gates (WUKONG_SERVE_NOGATE=1 skips for noisy
+    # local runs): an idle admission plane may not shift the micro's band
+    if os.environ.get("WUKONG_SERVE_NOGATE") != "1" and not bands_overlap:
+        raise SystemExit(
+            f"serve drill FAILED: admission on/off p50 bands disjoint on "
+            f"the 2-hop micro (off={b_off}, on={b_on}) — the off knob "
+            "must be zero-touch")
 
 
 def serve_mixed_main(device_ok: bool) -> None:
@@ -765,8 +820,13 @@ def tenants_main(device_ok: bool) -> None:
     per-tenant compliance / error budget / burn rates land in the SLO
     tracker and the artifact. A chaos sub-run injects transient failures
     at the proxy.serve boundary and records which tenants' budgets trip
-    the burn sentinel. Artifact: BENCH_TENANT.json (tenant_qps headline,
-    trended by scripts/bench_report.py)."""
+    the burn sentinel. A third sub-run is the admission control plane's
+    2x-capacity overload drill (clients doubled, quotas armed): it
+    self-gates that the protected tenant stays compliant and un-degraded
+    while bulk is shed lowest-weight-first. Artifact: BENCH_TENANT.json
+    (tenant_qps headline + protected_qps secondary, trended by
+    scripts/bench_report.py; the `overload` detail carries per-tenant
+    partial/rejected counts, decisions, and shed-by-cause)."""
     import numpy as np
 
     from wukong_tpu.config import Global
@@ -796,6 +856,41 @@ def tenants_main(device_ok: bool) -> None:
     chaos = emu.run_tenants(texts, duration_s=min(dur, 4.0), warmup_s=0.5,
                             chaos=True, seed=1)
 
+    # the admission plane's 2x-capacity overload variant: same three
+    # classes, every client count doubled, quotas armed — gold:8 /
+    # silver:4 / bulk:1 with a bulk q/s + in-flight quota and a small
+    # global in-flight ceiling so the degrade ladder engages. The drill
+    # self-gates below: the protected (top-weight) tenant must stay
+    # SLO-compliant and un-degraded while bulk absorbs the shed.
+    from wukong_tpu.runtime.admission import get_admission
+
+    prev_adm = (Global.enable_admission, Global.admission_quotas,
+                Global.admission_max_inflight)
+    Global.enable_admission = True
+    Global.admission_quotas = "gold:8:0:0:0;silver:4:0:0:0;bulk:1:25:4:0"
+    Global.admission_max_inflight = 6
+    get_admission().reset()
+    try:
+        over = emu.run_tenants(texts, duration_s=dur, warmup_s=1.0,
+                               overload_x=2.0, seed=1)
+    finally:
+        (Global.enable_admission, Global.admission_quotas,
+         Global.admission_max_inflight) = prev_adm
+        get_admission().reset()
+
+    decisions = over.get("admission", {}).get("decisions", {})
+    gold_slo = over["tenants"]["gold"]["slo"] or {}
+    gold_compliant = bool(
+        gold_slo.get("latency_met")
+        and (gold_slo.get("error_budget_remaining") or 0.0) >= 0.0)
+    # shed evidence comes from the decision counts (a rung-2 partial that
+    # happened to finish under its tightened budget still counts as shed)
+    bulk_shed = sum(n for k, n in decisions.items()
+                    if k.endswith("/bulk") and not k.startswith("admit/"))
+    gold_degraded = sum(n for k, n in decisions.items()
+                        if k.endswith("/gold") and not k.startswith("admit/"))
+    protected_qps = over["tenants"]["gold"]["qps"]
+
     def slim(out: dict) -> dict:
         # the committed detail keeps the per-tenant story and drops the
         # full signal/registry dumps (scrape surfaces carry those live)
@@ -812,10 +907,24 @@ def tenants_main(device_ok: bool) -> None:
         "tenant_qps": normal["qps"],
         "chaos_alerts": chaos["alerts"],
         "chaos_burn_dumps": len(chaos["burn_dumps"]),
+        "protected_qps": protected_qps,
         "backend": "tpu" if device_ok else "cpu",
         "detail": {
             "normal": slim(normal),
             "chaos": slim(chaos),
+            "overload": {
+                **slim(over),
+                "overload_x": over["overload_x"],
+                "protected_qps": protected_qps,
+                "gold_compliant": gold_compliant,
+                "gold_degraded_decisions": gold_degraded,
+                "bulk_shed_decisions": bulk_shed,
+                "decisions": decisions,
+                "shed_by_cause":
+                    over["signals"].get("shed_by_cause", {}),
+                "admission_quotas": "gold:8:0:0:0;silver:4:0:0:0;"
+                                    "bulk:1:25:4:0",
+            },
             "slo_report": normal["slo_report"],
             "knobs": {"max_tenants": Global.max_tenants,
                       "slo_burn_fast_x": Global.slo_burn_fast_x,
@@ -824,6 +933,25 @@ def tenants_main(device_ok: bool) -> None:
             "dataset": DATASET_NOTES["lubm"],
         },
     }, "BENCH_TENANT.json")
+    # the overload drill self-gates (ci_check runs it): the plane must
+    # shed bulk, never degrade the protected class, and keep it
+    # compliant under 2x load. WUKONG_TENANT_NOGATE=1 skips the gates
+    # for reduced-scale local runs
+    if os.environ.get("WUKONG_TENANT_NOGATE") != "1":
+        if bulk_shed <= 0:
+            raise SystemExit(
+                "tenant overload drill FAILED: no bulk shed decisions at "
+                "2x capacity — the admission plane never engaged")
+        if gold_degraded > 0:
+            raise SystemExit(
+                f"tenant overload drill FAILED: {gold_degraded} degrade "
+                "decisions hit the protected tenant (top weight class "
+                "must never be ladder-degraded)")
+        if not gold_compliant:
+            raise SystemExit(
+                f"tenant overload drill FAILED: protected tenant out of "
+                f"SLO under 2x overload while bulk was sheddable "
+                f"(slo={gold_slo})")
 
 
 def hotspot_main(device_ok: bool) -> None:

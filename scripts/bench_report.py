@@ -39,7 +39,13 @@ RUNG_RE = re.compile(r"^(BENCH(?:_[A-Za-z0-9]+)*?)_r(\d+)$")
 #: pentagon_device_speedup — the device-vs-host WCOJ win on the shape
 #: whose loss was closing-level intersection cost — trends next to the
 #: triangle walk-vs-wcoj primary instead of displacing it)
-SECONDARY_HEADLINES = (("pentagon_device_speedup", "speedup"),)
+SECONDARY_HEADLINES = (
+    ("pentagon_device_speedup", "speedup"),
+    # BENCH_TENANT's protected-tenant q/s under the 2x-capacity
+    # admission overload drill — the throughput the plane preserves for
+    # the top weight class while bulk is shed
+    ("protected_qps", "q/s"),
+)
 
 LOWER_BETTER = ("us", "ms", "ns", "sec")
 HIGHER_BETTER = ("q/s", "qps", "/s", "speedup")

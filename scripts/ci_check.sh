@@ -38,6 +38,13 @@ echo "== cyclic device-route drill (WCOJ host/device/walk identity) =="
 # on at least one case (exits non-zero otherwise; see cyclic_main gates)
 JAX_PLATFORMS=cpu python bench.py --cyclic
 
+echo "== tenant admission drill (2x-capacity overload ladder) =="
+# the multi-tenant SLO scenario incl. the admission plane's overload
+# variant: clients doubled, quotas armed — the protected tenant must
+# stay compliant and un-degraded while bulk is shed lowest-weight-first
+# (exits non-zero otherwise; see tenants_main gates)
+JAX_PLATFORMS=cpu python bench.py --tenants
+
 echo "== bench trajectory check =="
 python scripts/bench_report.py --check
 

@@ -1,0 +1,569 @@
+"""The admission control plane (ISSUE 16): per-tenant quotas,
+weighted-fair scheduling, and graceful overload degradation.
+
+Acceptance surface: the :class:`AdmissionController` reads ONLY the
+``ADMISSION_INPUTS`` signals (through ``read_admission_input``, gate-held
+literal by the ``admission-contract`` plugin) and enforces token-bucket
+q/s, in-flight, and aggregate-row quotas; the degrade ladder sheds
+lowest-weight-first (defer -> partial -> structured CAPACITY_EXCEEDED
+with retry-after) and NEVER ladder-degrades the top weight class — the
+ordering is pinned here; :class:`FairQueue` holds DRR fairness under a
+hostile bulk flood; standing-query maintenance inherits its owner's
+weight (priority inheritance); and the off knob degrades every hook to
+one check. The whole module runs in lockdep-checked mode: every
+admission lock created below is tracked, and teardown asserts the run
+produced no ordering cycles and no acquisition under a declared leaf.
+"""
+
+import numpy as np
+import pytest
+
+from wukong_tpu.analysis import lockdep
+from wukong_tpu.config import Global
+from wukong_tpu.engine.cpu import CPUEngine
+from wukong_tpu.loader.lubm import UB, VirtualLubmStrings, generate_lubm
+from wukong_tpu.obs import get_recorder
+from wukong_tpu.obs.events import EVENT_KINDS, get_journal
+from wukong_tpu.obs.slo import (
+    ADMISSION_INPUTS,
+    get_overload,
+    get_slo,
+    read_admission_input,
+    reset_labels,
+)
+from wukong_tpu.runtime import faults
+from wukong_tpu.runtime.admission import (
+    CONSUMED_INPUTS,
+    SHED_CAUSES,
+    AdmissionController,
+    FairQueue,
+    effective_tenant,
+    get_admission,
+    maybe_admission,
+    parse_quotas,
+    render_admission,
+)
+from wukong_tpu.runtime.proxy import Proxy
+from wukong_tpu.store.gstore import build_partition
+from wukong_tpu.utils.errors import ErrorCode, WukongError
+
+pytestmark = pytest.mark.admission
+
+PREFIX = """
+PREFIX rdf: <http://www.w3.org/1999/02/22-rdf-syntax-ns#>
+PREFIX ub: <http://swat.cse.lehigh.edu/onto/univ-bench.owl#>
+"""
+Q_CHAIN = PREFIX + """SELECT ?X ?Y WHERE {
+    ?X ub:memberOf ?Y .
+    ?Y ub:subOrganizationOf ?Z .
+}"""
+
+THREE_CLASSES = "gold:8:0:0:0;silver:4:0:0:0;bulk:1:0:0:0"
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _lockdep():
+    """Checked-lock mode for the whole module: the controller/queue/pool
+    locks created below are DebugLocks, and the teardown asserts the run
+    recorded no cycles and nothing acquired under a declared leaf."""
+    lockdep.install(True)
+    yield
+    assert lockdep.cycles() == []
+    assert lockdep.leaf_violations() == []
+    lockdep.install(False)
+
+
+@pytest.fixture(scope="module")
+def world(_lockdep):
+    triples, _ = generate_lubm(1, seed=42)
+    g = build_partition(triples, 0, 1)
+    ss = VirtualLubmStrings(1, seed=42)
+    return {"g": g, "ss": ss, "triples": triples}
+
+
+@pytest.fixture(scope="module")
+def proxy(world):
+    from wukong_tpu.planner.optimizer import make_planner
+
+    p = Proxy(world["g"], world["ss"],
+              CPUEngine(world["g"], world["ss"]))
+    p.planner = make_planner(world["triples"])
+    return p
+
+
+@pytest.fixture(autouse=True)
+def _hygiene(monkeypatch):
+    """Admission knobs at defaults (plane OFF), controller/signal/label
+    state clean, no journal or fault-plan leaks across tests."""
+    monkeypatch.setattr(Global, "enable_tracing", False)
+    monkeypatch.setattr(Global, "enable_tenant_accounting", True)
+    monkeypatch.setattr(Global, "slo_specs", "")
+    monkeypatch.setattr(Global, "enable_admission", False)
+    monkeypatch.setattr(Global, "admission_quotas", "")
+    monkeypatch.setattr(Global, "admission_default_weight", 1)
+    monkeypatch.setattr(Global, "admission_max_inflight", 0)
+    monkeypatch.setattr(Global, "admission_defer_ms", 0)
+    get_admission().reset()
+    get_slo().reset()
+    get_overload().reset()
+    reset_labels()
+    get_recorder().clear()
+    get_journal().clear()
+    faults.clear()
+    yield
+    get_admission().reset()
+    get_slo().reset()
+    get_overload().reset()
+    reset_labels()
+    get_journal().clear()
+    faults.clear()
+
+
+def mk_controller(t0: int = 1_000_000):
+    """A fresh controller on an injected usec clock (its state lock is a
+    DebugLock under the module's checked mode)."""
+    t = [t0]
+    return AdmissionController(clock=lambda: t[0]), t
+
+
+# ---------------------------------------------------------------------------
+# quota enforcement: token bucket, in-flight cap, aggregate row budget
+# ---------------------------------------------------------------------------
+
+def test_parse_quotas_roundtrip_and_errors():
+    qs = parse_quotas("gold:8:100:16:500000; bulk:1:10:2:0")
+    assert qs["gold"].weight == 8 and qs["gold"].qps == 100.0
+    assert qs["gold"].inflight == 16 and qs["gold"].rows_per_s == 500000
+    assert qs["bulk"].weight == 1
+    assert parse_quotas("") == {}
+    with pytest.raises(ValueError):
+        parse_quotas("gold:8:100")  # wrong arity
+    with pytest.raises(ValueError):
+        parse_quotas("gold:0:1:1:1")  # weight >= 1
+
+
+def test_token_bucket_quota_rejects_and_refills(monkeypatch):
+    monkeypatch.setattr(Global, "admission_quotas", "t:1:10:0:0")
+    monkeypatch.setattr(Global, "admission_burst_x", 1.0)
+    adm, t = mk_controller()
+    for _ in range(10):  # the full burst admits
+        assert adm.admit("t").action == "admit"
+    d = adm.admit("t")  # bucket empty, refill 100ms away > defer window
+    assert d.action == "reject" and d.cause == "admission_quota"
+    assert d.reason == "quota_qps" and not d.admitted
+    assert d.retry_after_s >= float(Global.admission_retry_after_s)
+    t[0] += 200_000  # 0.2s at 10 q/s refills 2 tokens
+    assert adm.admit("t").action == "admit"
+    assert adm.admit("t").action == "admit"
+    assert adm.admit("t").action == "reject"
+
+
+def test_quota_shortfall_within_defer_window_defers(monkeypatch):
+    """Degrade before drop: a shortfall the bucket refills within the
+    defer window defers (pre-charging the bucket) instead of rejecting."""
+    monkeypatch.setattr(Global, "admission_quotas", "t:1:10:0:0")
+    monkeypatch.setattr(Global, "admission_burst_x", 1.0)
+    monkeypatch.setattr(Global, "admission_defer_ms", 200)
+    adm, _t = mk_controller()
+    for _ in range(10):
+        assert adm.admit("t").action == "admit"
+    d = adm.admit("t")  # 100ms shortfall <= 200ms defer window
+    assert d.action == "defer" and d.cause == "admission_defer"
+    assert 0.0 < d.wait_s <= 0.2 and d.admitted
+
+
+def test_inflight_quota_rejects(monkeypatch):
+    monkeypatch.setattr(Global, "admission_quotas", "t:1:0:2:0")
+    adm, _t = mk_controller()
+    for _ in range(3):  # the proxy notes the arrival before consulting
+        get_overload().note_admit("t")
+    d = adm.admit("t")
+    assert d.action == "reject" and d.reason == "quota_inflight"
+    get_overload().note_done("t")
+    assert adm.admit("t").action == "admit"  # 2 in flight == the cap
+
+
+def test_row_budget_degrades_to_partial(monkeypatch):
+    monkeypatch.setattr(Global, "admission_quotas", "t:1:0:0:100")
+    adm, t = mk_controller()
+    adm.note_reply("t", 0)  # baseline for the rows/s EWMA
+    t[0] += 1_000_000
+    adm.note_reply("t", 5_000)  # 5000 rows/s instantaneous -> EWMA 1000
+    d = adm.admit("t")
+    assert d.action == "partial" and d.cause == "admission_partial"
+    assert d.reason == "quota_rows" and d.admitted
+    # a result-cache hit consumes no engine capacity: rows quota waived
+    assert adm.admit("t", cached=True).action == "admit"
+
+
+# ---------------------------------------------------------------------------
+# the degrade ladder: lowest-weight-first, top class never touched
+# ---------------------------------------------------------------------------
+
+def test_degrade_ladder_ordering_is_pinned(monkeypatch):
+    """The acceptance ordering: bulk is deferred at level 1 and partialed
+    at level 2 BEFORE silver is first touched at level 3, and gold (top
+    weight class) never ladder-degrades while bulk is sheddable."""
+    monkeypatch.setattr(Global, "admission_quotas", THREE_CLASSES)
+    adm, _t = mk_controller()
+    expect = {  # level -> {tenant: action}
+        0: {"bulk": "admit", "silver": "admit", "gold": "admit"},
+        1: {"bulk": "defer", "silver": "admit", "gold": "admit"},
+        2: {"bulk": "partial", "silver": "admit", "gold": "admit"},
+        3: {"bulk": "reject", "silver": "defer", "gold": "admit"},
+    }
+    for level, want in expect.items():
+        adm.overload_level = lambda lvl=level: lvl
+        for tenant, action in want.items():
+            d = adm.admit(tenant)
+            assert (d.tenant, d.action) == (tenant, action), (level, want)
+    # the rung-3 rejection carries the retry-after hint
+    adm.overload_level = lambda: 3
+    d = adm.admit("bulk")
+    assert d.retry_after_s >= float(Global.admission_retry_after_s)
+
+
+def test_single_weight_class_is_never_ladder_degraded(monkeypatch):
+    """With one active weight class everyone is the top class: overload
+    alone sheds nobody (quotas and deadlines still apply)."""
+    adm, _t = mk_controller()
+    adm.overload_level = lambda: 3
+    assert adm.admit("anyone").action == "admit"
+
+
+def test_overload_level_tracks_signals(monkeypatch):
+    monkeypatch.setattr(Global, "admission_max_inflight", 4)
+    monkeypatch.setattr(Global, "admission_delay_budget_us", 20_000)
+    adm, t = mk_controller()
+    assert adm.overload_level() == 0
+    for _ in range(8):  # 8 in flight vs a cap of 4 -> x=2 -> level 2
+        get_overload().note_admit("t")
+    t[0] += 5_000  # past the 2ms level-cache TTL
+    assert adm.overload_level() == 2
+    # within the TTL the cached level is reused (hot-path flatness)
+    get_overload().reset()
+    assert adm.overload_level() == 2
+    t[0] += 5_000
+    # worst-lane queue delay EWMA 1.5x the budget -> level 1
+    get_overload().note_queue_delay("interactive", 30_000)
+    assert adm.overload_level() == 1
+
+
+# ---------------------------------------------------------------------------
+# weighted-fair scheduling: DRR under a hostile bulk flood
+# ---------------------------------------------------------------------------
+
+def test_fair_queue_drr_under_hostile_bulk_flood():
+    fq = FairQueue()
+    for i in range(40):
+        fq.push("bulk", ("b", i), weight=1)
+    for i in range(16):
+        fq.push("gold", ("g", i), weight=8)
+    assert len(fq) == 56
+    assert fq.depths() == {"bulk": 40, "gold": 16}
+    order = [fq.pop() for _ in range(56)]
+    gold_at = [i for i, it in enumerate(order) if it[0] == "g"]
+    # 8:1 credit ratio: every gold item drains within the first ~20 pops
+    # despite arriving behind a 40-deep bulk flood...
+    assert len(gold_at) == 16 and max(gold_at) < 20
+    # ...without starving bulk (every active tenant earns credit each
+    # round), and FIFO holds within each tenant
+    assert any(it[0] == "b" for it in order[:20])
+    assert [it[1] for it in order if it[0] == "g"] == list(range(16))
+    assert [it[1] for it in order if it[0] == "b"] == list(range(40))
+    assert fq.pop() is None and len(fq) == 0
+
+
+def test_fair_queue_idle_tenant_forfeits_deficit():
+    fq = FairQueue()
+    fq.push("a", "a0", weight=8)
+    assert fq.pop() == "a0"
+    assert fq.pop() is None  # queue empty; "a" left the round
+    fq.push("b", "b0", weight=1)
+    fq.push("a", "a1", weight=8)
+    # "a" re-enters with zero deficit: no credit accumulated while idle
+    assert {fq.pop(), fq.pop()} == {"b0", "a1"}
+
+
+# ---------------------------------------------------------------------------
+# priority inheritance: maintenance work runs at its owner's weight
+# ---------------------------------------------------------------------------
+
+def test_effective_tenant_precedence():
+    from types import SimpleNamespace
+
+    assert effective_tenant(SimpleNamespace(owner_tenant="gold",
+                                            tenant="bulk")) == "gold"
+    assert effective_tenant(SimpleNamespace(owner_tenant=None,
+                                            tenant="bulk")) == "bulk"
+    assert effective_tenant(SimpleNamespace()) == "default"
+
+
+def test_standing_query_delta_inherits_owner_tenant(world):
+    from wukong_tpu.stream import StreamContext
+
+    ctx = StreamContext([build_partition(world["triples"][:4096], 0, 1)],
+                        world["ss"])
+    qid = ctx.register(Q_CHAIN, tenant="gold")
+    sq = ctx.continuous.queries[qid]
+    assert sq.tenant == "gold"
+    dq = ctx.continuous._make_delta_query(
+        sq, 0, [], np.empty((0, 0), dtype=np.int64))
+    assert dq.owner_tenant == "gold"
+    assert effective_tenant(dq) == "gold"
+
+
+# ---------------------------------------------------------------------------
+# the heavy lane: per-tenant weighted slot shares
+# ---------------------------------------------------------------------------
+
+def test_heavy_cap_weighted_share_is_work_conserving(monkeypatch):
+    monkeypatch.setattr(Global, "admission_quotas", THREE_CLASSES)
+    adm, _t = mk_controller()
+    # a lone holder gets the whole lane (work-conserving)
+    assert adm.heavy_cap_for("gold", 8, {}) == 8
+    assert adm.heavy_cap_for("bulk", 8, {}) == 8
+    # contended: slots split by weight across holders + requester
+    assert adm.heavy_cap_for("gold", 8, {"bulk": 1}) == 7  # 8*8//9
+    assert adm.heavy_cap_for("bulk", 8, {"gold": 3}) == 1  # floor >= 1
+    assert adm.heavy_cap_for("silver", 12, {"gold": 2, "bulk": 1}) == 3
+
+
+# ---------------------------------------------------------------------------
+# pool integration: the fair sub-lane, and the off knob's zero touch
+# ---------------------------------------------------------------------------
+
+def _planned(proxy):
+    from wukong_tpu.planner.heuristic import heuristic_plan
+    from wukong_tpu.sparql.parser import Parser
+
+    q = Parser(proxy.str_server).parse(Q_CHAIN)
+    heuristic_plan(q)
+    q.result.blind = True
+    return q
+
+
+def test_pool_fair_lane_executes_tenant_work(proxy, monkeypatch):
+    from wukong_tpu.runtime.scheduler import EnginePool
+
+    monkeypatch.setattr(Global, "enable_admission", True)
+    monkeypatch.setattr(Global, "admission_quotas", THREE_CLASSES)
+    pool = EnginePool(num_engines=2,
+                      make_engine=lambda tid: CPUEngine(
+                          proxy.g, proxy.str_server))
+    pool.start()
+    try:
+        qids = []
+        for tenant in ("bulk", "gold", "bulk", "silver"):
+            q = _planned(proxy)
+            q.tenant = tenant
+            qids.append(pool.submit(q))
+        outs = [pool.wait(qid, timeout=30) for qid in qids]
+        assert all(o is not None and o.result.status_code == 0
+                   for o in outs)
+        assert all(o.result.nrows == outs[0].result.nrows for o in outs)
+        assert pool._fair is not None and len(pool._fair) == 0
+    finally:
+        pool.stop()
+
+
+def test_pool_off_knob_never_builds_the_fair_queue(proxy):
+    from wukong_tpu.runtime.scheduler import EnginePool
+
+    pool = EnginePool(num_engines=2,
+                      make_engine=lambda tid: CPUEngine(
+                          proxy.g, proxy.str_server))
+    pool.start()
+    try:
+        q = _planned(proxy)
+        q.tenant = "gold"
+        out = pool.wait(pool.submit(q), timeout=30)
+        assert out is not None and out.result.status_code == 0
+        assert pool._fair is None  # zero-touch: the lane never exists
+    finally:
+        pool.stop()
+
+
+# ---------------------------------------------------------------------------
+# proxy integration: structured rejection, partial replies, zero touch
+# ---------------------------------------------------------------------------
+
+def test_proxy_rejects_with_capacity_exceeded(proxy, monkeypatch):
+    monkeypatch.setattr(Global, "enable_admission", True)
+    monkeypatch.setattr(Global, "admission_quotas", "bulk:1:0.5:0:0")
+    q = proxy.serve_query(Q_CHAIN, blind=True, tenant="bulk")
+    assert q.result.status_code == ErrorCode.SUCCESS  # burst admits one
+    with pytest.raises(WukongError) as ei:
+        proxy.serve_query(Q_CHAIN, blind=True, tenant="bulk")
+    assert ei.value.code == ErrorCode.CAPACITY_EXCEEDED
+    assert "retry after" in str(ei.value)
+    # the shed charged the declared cause on the overload bus...
+    assert read_admission_input("shed_by_cause").get(
+        "admission_quota", 0) >= 1
+    # ...the rejection reached tenant error accounting...
+    assert get_slo().compliance("bulk")["errors"] == 1
+    # ...and the journal carries the admission.quota event, findable
+    # through the dotted-kind filter as one admission timeline
+    evs = get_journal().last(kind="admission")
+    assert any(e.kind == "admission.quota" and e.tenant == "bulk"
+               for e in evs)
+    # the in-flight slot was released through the error path
+    assert read_admission_input("tenant_inflight").get("bulk", 0) == 0
+
+
+def test_proxy_partial_reply_end_to_end(proxy, monkeypatch):
+    """Rung 2 end to end: an over-row-budget tenant's reply degrades to
+    a structured partial (PR 1 mark_partial machinery), not an error."""
+    monkeypatch.setattr(Global, "enable_admission", True)
+    monkeypatch.setattr(Global, "admission_quotas", "bulk:1:0:0:50")
+    monkeypatch.setattr(Global, "admission_partial_deadline_ms", 10_000)
+    monkeypatch.setattr(Global, "admission_partial_budget_rows", 1)
+    adm = get_admission()
+    adm.note_reply("bulk", 0)
+    adm.note_reply("bulk", 1_000_000)  # row-rate EWMA far over budget
+    q = proxy.serve_query(Q_CHAIN, blind=True, tenant="bulk")
+    assert q.result.complete is False  # truncated, with rows kept
+    assert q.result.dropped_patterns
+    assert read_admission_input("shed_by_cause").get(
+        "admission_partial", 0) >= 1
+
+
+def test_proxy_off_knob_zero_touch(proxy):
+    assert maybe_admission() is None
+    q = proxy.serve_query(Q_CHAIN, blind=True, tenant="bulk")
+    assert q.result.status_code == ErrorCode.SUCCESS
+    rep = get_admission().report()
+    assert rep["enabled"] is False and rep["decisions"] == {}
+
+
+def test_admission_report_and_render(monkeypatch):
+    monkeypatch.setattr(Global, "enable_admission", True)
+    monkeypatch.setattr(Global, "admission_quotas", THREE_CLASSES)
+    adm = get_admission()
+    assert adm.admit("gold").action == "admit"
+    rep = adm.report()
+    assert rep["enabled"] is True
+    assert rep["quotas"]["gold"]["weight"] == 8
+    assert rep["decisions"] == {"admit/gold": 1}
+    assert set(rep["signals"]) == set(CONSUMED_INPUTS)
+    text, js = render_admission(4)
+    assert "wukong-admission" in text
+    assert js["decisions"] == {"admit/gold": 1}
+
+
+# ---------------------------------------------------------------------------
+# the consumer contract, closed sets, and the analysis gate
+# ---------------------------------------------------------------------------
+
+def test_contracts_are_literal_and_closed():
+    """Runtime mirror of the admission-contract gate."""
+    assert set(CONSUMED_INPUTS) <= set(ADMISSION_INPUTS)
+    assert set(SHED_CAUSES) == {"admission_defer", "admission_partial",
+                                "admission_reject", "admission_quota"}
+    assert "admission.shed" in EVENT_KINDS
+    assert "admission.quota" in EVENT_KINDS
+    with pytest.raises(KeyError):
+        read_admission_input("not_a_signal")
+
+
+def test_admission_gate_fixtures(tmp_path):
+    """Gate negatives: an undeclared consumed signal, an undeclared read,
+    an unused declared cause, an undeclared shed cause, an undeclared
+    leaf lock, and an unannotated shared container all surface; the
+    clean shape and a tree without an admission plane do not."""
+    from wukong_tpu.analysis import run_analysis
+
+    def write(tree: dict) -> str:
+        root = tmp_path / f"pkg{len(list(tmp_path.iterdir()))}"
+        for rel, src in tree.items():
+            p = root / rel
+            p.parent.mkdir(parents=True, exist_ok=True)
+            p.write_text(src)
+        return str(root)
+
+    slo_src = "ADMISSION_INPUTS = {'lane_depth': 'wukong_pool_lane_depth'}\n"
+    bad = write({"obs/slo.py": slo_src, "runtime/admission.py": (
+        "CONSUMED_INPUTS = ('lane_depth', 'phantom_signal')\n"
+        "SHED_CAUSES = ('admission_defer', 'admission_ghost')\n"
+        "def f():\n"
+        "    read_admission_input('lane_depth')\n"
+        "    read_admission_input('undeclared_read')\n"
+        "    maybe_note_shed('admission_defer', 't')\n"
+        "    maybe_note_shed('not_declared', 't')\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self.tenants = {}\n"
+        "        self.lock = make_lock('admission.state')\n")})
+    msgs = "\n".join(str(v) for v in run_analysis(
+        bad, plugins=["admission-contract"]))
+    assert "'phantom_signal'" in msgs  # consumed but never promised
+    assert "'undeclared_read'" in msgs  # read outside CONSUMED_INPUTS
+    assert "'admission_ghost'" in msgs  # declared cause, no call site
+    assert "'not_declared'" in msgs  # shed cause outside the closed set
+    assert "admission.state" in msgs  # lock not declared a leaf
+    assert "C.tenants" in msgs  # unannotated shared structure
+
+    good = write({"obs/slo.py": slo_src, "runtime/admission.py": (
+        "CONSUMED_INPUTS = ('lane_depth',)\n"
+        "SHED_CAUSES = ('admission_defer',)\n"
+        "declare_leaf('admission.state')\n"
+        "def f():\n"
+        "    read_admission_input('lane_depth')\n"
+        "    maybe_note_shed('admission_defer', 't')\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self.tenants = {}  # guarded by: _lock\n"
+        "        self.lock = make_lock('admission.state')\n")})
+    assert run_analysis(good, plugins=["admission-contract"]) == []
+
+    # a tree without an admission plane is not checked (partial fixtures)
+    empty = write({"other.py": "x = 1\n"})
+    assert run_analysis(empty, plugins=["admission-contract"]) == []
+
+
+def test_admission_gate_holds_on_the_live_tree():
+    import os
+
+    from wukong_tpu.analysis import run_analysis
+
+    pkg = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "wukong_tpu")
+    assert run_analysis(pkg, plugins=["admission-contract"]) == []
+
+
+# ---------------------------------------------------------------------------
+# satellite: the result-cache cost model (pairs with the row quotas)
+# ---------------------------------------------------------------------------
+
+def test_result_cache_cost_model_admission_bar(monkeypatch):
+    from types import SimpleNamespace
+
+    from wukong_tpu.serve.result_cache import ResultCache
+
+    monkeypatch.setattr(Global, "result_cache_min_reads", 2)
+    monkeypatch.setattr(Global, "result_cache_cost_model", True)
+    cheap_giant = SimpleNamespace(nbytes=1 << 20, cost_us=10.0)
+    mid = SimpleNamespace(nbytes=51_200, cost_us=100.0)
+    dear_small = SimpleNamespace(nbytes=100, cost_us=10_000.0)
+    assert ResultCache._admit_bar(cheap_giant) == 8  # density >= 4096: 4x
+    assert ResultCache._admit_bar(mid) == 4  # density >= 512: 2x
+    assert ResultCache._admit_bar(dear_small) == 2  # base bar
+    monkeypatch.setattr(Global, "result_cache_cost_model", False)
+    assert ResultCache._admit_bar(cheap_giant) == 2  # off: flat bar
+
+
+def test_result_cache_eviction_prefers_cheap_giants(monkeypatch):
+    """Cheap-to-recompute giants stop evicting expensive small entries:
+    the victim scan picks the lowest cost-per-byte, not FIFO order."""
+    from types import SimpleNamespace
+
+    from wukong_tpu.serve.result_cache import ResultCache
+
+    monkeypatch.setattr(Global, "result_cache_cost_model", True)
+    rc = ResultCache()
+    rc._entries["dear"] = SimpleNamespace(nbytes=100, cost_us=50_000.0)
+    rc._entries["cheap"] = SimpleNamespace(nbytes=1 << 20, cost_us=10.0)
+    assert rc._pick_victim_locked(keep=None) == "cheap"
+    assert rc._pick_victim_locked(keep="cheap") == "dear"
+    monkeypatch.setattr(Global, "result_cache_cost_model", False)
+    assert rc._pick_victim_locked(keep=None) == "dear"  # FIFO when off

@@ -49,7 +49,8 @@ def test_plugin_registry():
         "no-bare-print", "batcher-route", "wal-hook", "guarded-by",
         "fault-sites", "config-readme", "metrics-readme", "error-taxonomy",
         "heat-telemetry", "join-strategy", "slo-telemetry",
-        "placement-telemetry", "migration-safety", "cache-coherence"}
+        "placement-telemetry", "migration-safety", "cache-coherence",
+        "admission-contract"}
 
 
 def test_unknown_plugin_rejected():

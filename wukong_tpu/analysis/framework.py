@@ -188,6 +188,7 @@ def _load_builtin_plugins() -> None:
     # import for the registration side effect; lazy so lockdep (runtime
     # checker, imported by hot modules) never drags the AST gates in
     from wukong_tpu.analysis import (  # noqa: F401
+        admitgate,
         cachegate,
         drift,
         guarded,

@@ -312,6 +312,61 @@ class GlobalConfig:
     view_demote_touch_pct: int = 60
     # bound on concurrently maintained views
     views_max: int = 64
+    # cost-aware admission/eviction (GDSF-lite): entries carry their
+    # measured recompute cost, eviction drops the lowest
+    # cost x (1 + hits) / bytes score instead of strict LRU, so a
+    # cheap-to-recompute giant can no longer evict many expensive small
+    # entries. Off restores pure LRU byte accounting.
+    result_cache_cost_model: bool = True
+
+    # ---- admission control plane (runtime/admission.py; all mutable) ----
+    # the decision half of the tenant SLO plane: per-tenant quotas
+    # (token-bucket q/s, in-flight caps, aggregate row budgets),
+    # deficit-round-robin weighted-fair scheduling over per-tenant
+    # sub-queues, and the three-rung overload degrade ladder (defer ->
+    # partial -> CAPACITY_EXCEEDED), consulted at the proxy admission
+    # point and reading ONLY ADMISSION_INPUTS signals. OFF by default:
+    # the serving path is byte-unchanged until armed (the
+    # migration_enable / enable_result_cache actuator posture).
+    enable_admission: bool = False
+    # ";"-separated per-tenant quota entries
+    # "<tenant>:<weight>:<qps>:<inflight>:<rows_per_s>" — weight drives
+    # the DRR fair queue and the shed order (lowest weight first); qps 0
+    # = no rate quota, inflight 0 = no concurrency cap, rows_per_s 0 =
+    # no aggregate row budget. E.g. "gold:8:0:0:0;silver:4:0:0:0;
+    # bulk:1:200:8:500000". Tenants not listed get admission_default_*.
+    admission_quotas: str = ""
+    # weight for tenants without a quota entry (DRR + shed ordering)
+    admission_default_weight: int = 1
+    # token-bucket burst: a tenant may burst to this many x its q/s
+    # quota before the bucket empties
+    admission_burst_x: float = 2.0
+    # congestion signal: the worst per-lane queue-delay EWMA is compared
+    # to this budget; each doubling past it raises the overload level
+    # one rung (level 1 defers, 2 marks partial, 3 rejects — applied
+    # lowest-weight-first)
+    admission_delay_budget_us: int = 20000
+    # aggregate in-flight ceiling feeding the same overload level (the
+    # congestion signal for direct-execution serving where no pool lane
+    # queues exist); 0 derives 4 x the live engine count, or 8 with no
+    # pool attached
+    admission_max_inflight: int = 0
+    # rung-1 defer: how long an admission defers a sheddable query (past
+    # the batch window, letting congestion drain); 0 derives
+    # 2 x batch_window_us
+    admission_defer_ms: int = 0
+    # rung-2 degrade: the tightened deadline/row budget stamped on a
+    # partial-results admission (mark_partial settles the reply with
+    # complete=False through the PR 1 machinery)
+    admission_partial_deadline_ms: int = 250
+    admission_partial_budget_rows: int = 200000
+    # rung-3 rejection: the retry-after hint (seconds) carried by the
+    # structured CAPACITY_EXCEEDED reply and the admission.shed event
+    admission_retry_after_s: float = 1.0
+    # DRR quantum: queue credits granted per round per unit of tenant
+    # weight (1 credit = 1 query); weight 8 drains 8 queries per round
+    # while weight 1 drains 1
+    admission_drr_quantum: int = 1
 
     # ---- concurrency checking (wukong_tpu/analysis/lockdep.py) ----
     # lockdep-style runtime lock-order checker: locks created through the

@@ -57,6 +57,10 @@ EVENT_KINDS = (
     # (runtime/migration.py; correlate with -K shard.migrate)
     "shard.migrate.start", "shard.migrate.catchup",
     "shard.migrate.cutover", "shard.migrate.retire", "shard.migrate.abort",
+    # the admission control plane (runtime/admission.py): degrade-ladder
+    # sheds and per-tenant quota breaches (correlate with -K admission —
+    # shed storms, burn alerts, and breaker trips on one timeline)
+    "admission.shed", "admission.quota",
 )
 
 # the journal lock guards a deque append and the JSONL file handle —

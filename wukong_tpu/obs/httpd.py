@@ -93,7 +93,8 @@ class _MetricsHandler(BaseHTTPRequestHandler):
         elif path in ("/top", "/top.json", "/slo", "/slo.json",
                       "/history", "/history.json", "/events",
                       "/events.json", "/plan", "/plan.json",
-                      "/cache", "/cache.json"):
+                      "/cache", "/cache.json",
+                      "/admission", "/admission.json"):
             # top(1) for shards / templates / lanes (obs/profile.py), the
             # tenant SLO + overload-signal report (obs/slo.py), and the
             # observatory plane: metrics trend windows (obs/tsdb.py), the
@@ -111,6 +112,13 @@ class _MetricsHandler(BaseHTTPRequestHandler):
                 from wukong_tpu.obs.slo import render_slo
 
                 text, js = render_slo(k)
+            elif path.startswith("/admission"):
+                # the admission control plane: overload level, per-tenant
+                # quota/decision table, consumed congestion signals
+                # (runtime/admission.py)
+                from wukong_tpu.runtime.admission import render_admission
+
+                text, js = render_admission(k)
             elif path.startswith("/cache"):
                 # the serving-cache observatory: shadow hit rate, template
                 # popularity + cacheability verdicts, invalidation trend
@@ -194,7 +202,7 @@ def maybe_start_metrics_http(port: int | None = None):
         _server = srv
         log_info(f"metrics http endpoint on :{srv.server_address[1]} "
                  "(/metrics, /metrics.json, /top, /slo, /history, "
-                 "/events, /plan, /cache, /healthz)")
+                 "/events, /plan, /cache, /admission, /healthz)")
         return srv
 
 

@@ -442,6 +442,9 @@ class OverloadSignals:
         self._lanes: dict[str, _LaneSignal] = {}  # guarded by: _lock
         self._tenants: dict[str, _TenantSignal] = {}  # guarded by: _lock
         self._sheds: dict[str, int] = {}  # guarded by: _lock
+        # (cause, tenant) -> count: who absorbed each shed class — the
+        # admission drill's "bulk absorbs the damage" evidence
+        self._shed_tenants: dict = {}  # guarded by: _lock
 
     # -- producers ------------------------------------------------------
     def note_queue_delay(self, lane: str, dur_us: int) -> None:
@@ -479,6 +482,8 @@ class OverloadSignals:
     def note_shed(self, cause: str, tenant: str) -> None:
         with self._lock:
             self._sheds[cause] = self._sheds.get(cause, 0) + 1
+            k = (cause, tenant)
+            self._shed_tenants[k] = self._shed_tenants.get(k, 0) + 1
         _M_SHED.labels(cause=cause, tenant=tenant).inc()
 
     # -- pull-gauge feeds ----------------------------------------------
@@ -508,6 +513,8 @@ class OverloadSignals:
                            "arrival_qps": round(s.arrival_ewma_qps, 2)}
                        for t, s in self._tenants.items()}
             sheds = dict(self._sheds)
+            shed_tenants = {f"{c}/{t}": n
+                            for (c, t), n in self._shed_tenants.items()}
         depths = {}
         util = 0.0
         try:
@@ -525,7 +532,8 @@ class OverloadSignals:
             lanes.setdefault(lane, {"queue_delay_ewma_us": 0.0,
                                     "pops": 0})["depth"] = d
         return {"lanes": lanes, "pool_utilization": round(util, 4),
-                "shed_by_cause": sheds, "tenants": tenants,
+                "shed_by_cause": sheds, "shed_by_tenant": shed_tenants,
+                "tenants": tenants,
                 "inputs": dict(ADMISSION_INPUTS)}
 
     def reset(self) -> None:
@@ -533,6 +541,7 @@ class OverloadSignals:
             self._lanes.clear()
             self._tenants.clear()
             self._sheds.clear()
+            self._shed_tenants.clear()
 
 
 # process-wide instances (the proxy, scheduler, batcher, and /slo share them)
@@ -573,6 +582,59 @@ def maybe_note_shed(cause: str, tenant) -> None:
     if not Global.enable_tenant_accounting:
         return
     _signals.note_shed(cause, tenant_label(tenant))
+
+
+# ---------------------------------------------------------------------------
+# the admission controller's ONLY read path
+# ---------------------------------------------------------------------------
+
+def read_admission_input(signal: str):
+    """The single accessor through which the admission controller
+    (runtime/admission.py) reads the overload bus — the serving cache's
+    ``read_cache_input`` pattern. Every signal name must be declared in
+    ``ADMISSION_INPUTS`` (KeyError otherwise — the admit gate holds the
+    controller's literal ``CONSUMED_INPUTS`` to this registry statically,
+    and this raises on anything undeclared dynamically). Returns live
+    values, never cached:
+
+    - ``lane_queue_delay_ewma`` -> {lane: ewma_us}
+    - ``lane_depth``            -> {lane: queued items}
+    - ``pool_utilization``      -> float 0..1
+    - ``tenant_inflight``       -> {tenant: in-flight count}
+    - ``tenant_arrival_rate``   -> {tenant: arrival EWMA q/s}
+    - ``shed_by_cause``         -> {cause: count}
+    - ``tenant_latency``        -> {tenant: windowed p-latency us}
+    - ``tenant_replies``        -> {tenant: windowed reply count}
+    """
+    if signal not in ADMISSION_INPUTS:
+        raise KeyError(f"undeclared admission input {signal!r} "
+                       f"(declared: {sorted(ADMISSION_INPUTS)})")
+    if signal == "lane_queue_delay_ewma":
+        return {lane: v for (lane,), v
+                in _signals.lane_delay_series().items()}
+    if signal == "tenant_inflight":
+        return {t: v for (t,), v in _signals.inflight_series().items()}
+    if signal == "tenant_arrival_rate":
+        return {t: v for (t,), v in _signals.arrival_series().items()}
+    if signal == "shed_by_cause":
+        with _signals._lock:
+            return dict(_signals._sheds)
+    if signal in ("lane_depth", "pool_utilization"):
+        try:
+            from wukong_tpu.runtime.scheduler import (
+                _lane_depth_series,
+                _pool_utilization,
+            )
+        except Exception:
+            return {} if signal == "lane_depth" else 0.0
+        if signal == "lane_depth":
+            return {k[0]: int(v) for k, v in _lane_depth_series().items()}
+        return float(_pool_utilization())
+    # tenant_latency / tenant_replies: the tracker's windowed view
+    rep = _tracker.report()
+    if signal == "tenant_latency":
+        return {r["tenant"]: r["latency_p_us"] for r in rep["tenants"]}
+    return {r["tenant"]: r["samples"] for r in rep["tenants"]}
 
 
 # ---------------------------------------------------------------------------

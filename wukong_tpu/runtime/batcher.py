@@ -484,6 +484,11 @@ class FusedGroup:
         # the moment it completes. None = no chaining (light groups keep
         # the global iteration-boundary drain).
         self.key = key
+        # owning tenant (groups coalesce per-template; the first member
+        # names the group) — the pool's per-tenant heavy-lane slot
+        # accounting (_heavy_pick_locked) keys on this tag
+        self.tenant = (getattr(getattr(members[0], "q", None), "tenant",
+                               None) or "default") if members else "default"
         # in-flight accounting settled exactly once; the flag needs its
         # own lock because run()'s finally (engine thread) can race
         # fail_all() from the scheduler's death handler or the flusher —

@@ -46,6 +46,10 @@ top [-k <n>] [-j]            hot shards / templates / lanes (like top(1);
 slo [-k <n>] [-j]            per-tenant SLO compliance / error budgets /
                              burn rates + the overload signal bus (also
                              served at GET /slo on the metrics port)
+admission [-k <n>] [-j]      admission control plane: overload level,
+                             per-tenant quotas/weights, decision counts,
+                             consumed congestion signals (also
+                             GET /admission)
 history [-k <n>] [-w <sec>] [-j]
                              metrics trend windows from the time-series
                              ring: counter rates, histogram percentiles,
@@ -127,6 +131,8 @@ class Console:
                 self._top(rest)
             elif cmd == "slo":
                 self._slo(rest)
+            elif cmd == "admission":
+                self._admission(rest)
             elif cmd == "history":
                 self._history(rest)
             elif cmd == "events":
@@ -397,6 +403,17 @@ class Console:
         ns = ap.parse_args(rest)
         self._print_report(ns.j, *render_events(ns.k, shard=ns.s,
                                                 kind=ns.K))
+
+    def _admission(self, rest) -> None:
+        """admission: the admission control plane (the /admission body)."""
+        from wukong_tpu.runtime.admission import render_admission
+
+        ap = argparse.ArgumentParser(prog="admission")
+        ap.add_argument("-k", type=int, default=None,
+                        help="tenant rows shown (default: the top_k knob)")
+        ap.add_argument("-j", action="store_true", help="JSON output")
+        ns = ap.parse_args(rest)
+        self._print_report(ns.j, *render_admission(ns.k))
 
     def _cache(self, rest) -> None:
         """cache: the serving plane + observatory (the /cache body)."""

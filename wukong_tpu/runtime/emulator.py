@@ -1052,7 +1052,7 @@ class Emulator:
     def run_tenants(self, texts: list, duration_s: float = 3.0,
                     warmup_s: float = 0.3, tenants: list | None = None,
                     chaos: bool = False, chaos_p: float = 0.25,
-                    seed: int = 0) -> dict:
+                    overload_x: float = 1.0, seed: int = 0) -> dict:
         """N tenant classes with conflicting SLOs drive closed-loop
         clients through the REAL serving entry (``serve_query`` with a
         tenant identity), so per-tenant compliance, remaining error
@@ -1071,6 +1071,13 @@ class Emulator:
         window (tracing is forced on for the run so dumps have traces).
         A tenant entry may carry its own ``texts`` list; otherwise all
         classes share ``texts``.
+
+        ``overload_x > 1`` multiplies every class's client count — the
+        admission plane's 2x-capacity overload drill: with
+        ``enable_admission`` armed the per-tenant ``partial`` /
+        ``rejected`` counts and the ``admission`` report in the output
+        show the degrade ladder shedding lowest-weight-first while the
+        protected class stays compliant.
         """
         import threading
 
@@ -1114,7 +1121,8 @@ class Emulator:
 
         stop = threading.Event()
         t_measure = [time.monotonic() + warmup_s]
-        stats = [{"served": 0, "errors": 0, "lat": []} for _ in classes]
+        stats = [{"served": 0, "errors": 0, "partial": 0, "rejected": 0,
+                  "lat": []} for _ in classes]
 
         def client(ti: int, k: int) -> None:
             c = classes[ti]
@@ -1124,27 +1132,41 @@ class Emulator:
             while not stop.is_set():
                 text = pool[int(rng.integers(0, len(pool)))]
                 t0 = get_usec()
+                partial = rejected = False
                 try:
                     q = self.proxy.serve_query(text, blind=True,
                                                tenant=name)
                     ok = q.result.status_code == ErrorCode.SUCCESS
+                    # the degrade ladder's rung 2: a truncated reply
+                    # (mark_partial) counts as neither served nor error
+                    partial = not q.result.complete
+                except WukongError as e:
+                    ok = False
+                    rejected = e.code == ErrorCode.CAPACITY_EXCEEDED
                 except Exception:
                     ok = False
                 dt = get_usec() - t0
                 if time.monotonic() >= t_measure[0]:
                     st = stats[ti]
-                    if ok:
+                    if rejected:
+                        st["rejected"] += 1
+                    elif partial:
+                        st["partial"] += 1
+                    elif ok:
                         st["served"] += 1
                         st["lat"].append(dt)
                     else:
                         st["errors"] += 1
                     self.monitor.add_latency(dt, qtype=ti)
 
+        nclients = {c["tenant"]: max(int(round(
+            int(c.get("clients", 1)) * max(float(overload_x), 0.1))), 1)
+            for c in classes}
         threads = [threading.Thread(target=client, args=(ti, k),
                                     daemon=True,
                                     name=f"tenant-{c['tenant']}-{k}")
                    for ti, c in enumerate(classes)
-                   for k in range(int(c.get("clients", 1)))]
+                   for k in range(nclients[c["tenant"]])]
         try:
             for t in threads:
                 t.start()
@@ -1172,9 +1194,11 @@ class Emulator:
             lat = sorted(st["lat"])
             total += st["served"]
             out_tenants[name] = {
-                "clients": int(c.get("clients", 1)),
+                "clients": nclients[name],
                 "served": st["served"],
                 "errors": st["errors"],
+                "partial": st["partial"],
+                "rejected": st["rejected"],
                 "qps": round(st["served"] / duration_s, 1),
                 "p50_us": int(lat[len(lat) // 2]) if lat else 0,
                 "p99_us": int(lat[int(len(lat) * 0.99)]) if lat else 0,
@@ -1186,6 +1210,7 @@ class Emulator:
             "duration_s": duration_s,
             "chaos": bool(chaos),
             "chaos_p": chaos_p if chaos else 0.0,
+            "overload_x": float(overload_x),
             "qps": round(total / duration_s, 1),
             "tenant_qps": round(total / duration_s, 1),
             "tenants": out_tenants,
@@ -1196,6 +1221,10 @@ class Emulator:
             "slo_report": tracker.report(),
             "signals": signals.report(),
         }
+        if Global.enable_admission:
+            from wukong_tpu.runtime.admission import get_admission
+
+            out["admission"] = get_admission().report()
         for line in self.monitor.slo_lines(k=len(classes)):
             log_info(line)
         log_info(f"run_tenants: {out['qps']:,.0f} q/s over {duration_s}s"

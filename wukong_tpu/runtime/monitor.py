@@ -164,6 +164,8 @@ class Monitor:
                 log_info(line)
             for line in self.slo_lines(k=3):
                 log_info(line)
+            for line in self.admission_lines(k=3):
+                log_info(line)
             for line in self.events_lines(k=4):
                 log_info(line)
             for line in self.placement_lines():
@@ -307,6 +309,31 @@ class Monitor:
                 + f" burn {burn.get('fast', 0):.1f}/{burn.get('slow', 0):.1f}"
                 + (f" alerts {r['alerts']}" if r["alerts"] else ""))
         return ["SLO[" + "  ".join(parts) + "]"]
+
+    def admission_lines(self, k: int = 3) -> list[str]:
+        """Rolling-report line for the admission control plane
+        (runtime/admission.py): overload level + the k busiest tenants'
+        non-admit decision counts — quiet while the plane is off or has
+        decided nothing (off-knob runs print nothing)."""
+        from wukong_tpu.config import Global
+
+        if not Global.enable_admission:
+            return []
+        from wukong_tpu.runtime.admission import get_admission
+
+        adm = get_admission()
+        rep = adm.report()
+        decisions = rep["decisions"]
+        if not decisions:
+            return []
+        shed = {kt: n for kt, n in decisions.items()
+                if not kt.startswith("admit/")}
+        top = sorted(shed.items(), key=lambda kv: -kv[1])[:k]
+        parts = [f"{kt}:{n}" for kt, n in top]
+        total = sum(decisions.values())
+        return ["Admission[level " + str(rep["level"])
+                + f" {total:,} decisions"
+                + ("  " + "  ".join(parts) if parts else "") + "]"]
 
     def events_lines(self, k: int = 4) -> list[str]:
         """Rolling-report line for the cluster event journal

@@ -14,6 +14,7 @@ Glues parser -> planner -> engine and implements the reference's query modes:
 from __future__ import annotations
 
 import threading
+import time
 
 import numpy as np
 
@@ -29,6 +30,7 @@ from wukong_tpu.obs import (
 )
 from wukong_tpu.obs.reuse import maybe_observe_reuse
 from wukong_tpu.obs.slo import get_overload, get_slo, tenant_label
+from wukong_tpu.runtime.admission import maybe_admission
 from wukong_tpu.planner.heuristic import heuristic_plan
 from wukong_tpu.planner.plan_file import set_plan
 from wukong_tpu.runtime.batcher import (
@@ -296,10 +298,14 @@ class Proxy:
         if trace is not None:
             trace.tenant = ten
 
+        adm_d = None
+
         def prepare():
             if trace is None:
                 qq = self._parse_text(text)
                 self._plan_prepared(qq, blind, plan_text, tenant=ten)
+                if adm_d is not None:
+                    adm_d.apply(qq)
                 return qq
             with trace.span("proxy.parse"):
                 qq = self._parse_text(text)
@@ -307,6 +313,8 @@ class Proxy:
             qq.qid = trace.qid
             with trace.span("proxy.plan"):
                 self._plan_prepared(qq, blind, plan_text, tenant=ten)
+            if adm_d is not None:
+                adm_d.apply(qq)
             return qq
 
         q = None
@@ -315,6 +323,7 @@ class Proxy:
         # decisions), and scope the JAX device profiler around the traced
         # execution when WUKONG_XPROF_DIR asks for an XProf capture
         try:
+            adm_d = self._consult_admission(ten)
             with activate(trace), maybe_device_trace():
                 q, total_us = self._run_repeats(prepare, repeats, device,
                                                 trace)
@@ -346,6 +355,7 @@ class Proxy:
         self._observe_slo(ten, get_usec() - t0_us,
                           ok=status == ErrorCode.SUCCESS, status=status,
                           trace=trace)
+        self._note_admission_reply(ten, q)
         # serving-cache observatory (obs/reuse.py): template popularity +
         # the observe-only shadow-cache probe, charged at the reply point
         # against the store version the read executed under
@@ -427,6 +437,36 @@ class Proxy:
         ten = tenant_label(tenant)
         get_overload().note_admit(ten)
         return ten
+
+    def _consult_admission(self, ten: str, cached: bool = False):
+        """The admission control plane's consult point, AFTER ``_admit``
+        (so the in-flight signal includes the query under decision) and
+        inside the caller's reply-accounting try (a rejection releases
+        the in-flight slot through ``_observe_slo``). One knob check
+        when the plane is off. Rung-1 defers sleep HERE on the serving
+        thread (past the batch window, draining congestion); rung-3
+        raises the structured CAPACITY_EXCEEDED rejection; the returned
+        Decision stamps a rung-2 partial budget onto the prepared
+        query."""
+        adm = maybe_admission()
+        if adm is None:
+            return None
+        d = adm.admit(ten, cached=cached)
+        if d.action == "reject":
+            raise WukongError(
+                ErrorCode.CAPACITY_EXCEEDED,
+                f"admission shed: tenant {ten!r} ({d.reason or 'overload'})"
+                f" — retry after {d.retry_after_s:.1f}s")
+        if d.action == "defer" and d.wait_s > 0:
+            time.sleep(min(d.wait_s, 5.0))
+        return d
+
+    def _note_admission_reply(self, ten: str, q) -> None:
+        """Reply-side aggregate-row accounting for the row-budget quota
+        (one knob check when the plane is off)."""
+        adm = maybe_admission()
+        if adm is not None:
+            adm.note_reply(ten, int(getattr(q.result, "nrows", 0)))
 
     def _observe_slo(self, tenant: str, dur_us: int, ok: bool, status,
                      trace) -> None:
@@ -834,15 +874,20 @@ class Proxy:
         if trace is not None:
             trace.tenant = ten
 
+        adm_d = None
+
         def prepare():
             qq = self._parse_text(text)
             if trace is not None:
                 qq.trace = trace
                 qq.qid = trace.qid
             self._plan_prepared(qq, blind, None, tenant=ten)
+            if adm_d is not None:
+                adm_d.apply(qq)
             return qq
 
         try:
+            adm_d = self._consult_admission(ten)
             with activate(trace):
                 q, _us = self._run_repeats(prepare, 1, device, trace)
         except Exception as e:
@@ -865,6 +910,7 @@ class Proxy:
         self._observe_slo(ten, get_usec() - t0_us,
                           ok=status == ErrorCode.SUCCESS, status=status,
                           trace=trace)
+        self._note_admission_reply(ten, q)
         self._observe_reuse(q, ten, text)
         return q
 
@@ -890,6 +936,9 @@ class Proxy:
         try:
             from wukong_tpu.runtime import faults
 
+            # cached hits consume no engine capacity: only the q/s +
+            # in-flight quotas apply (cached=True skips the ladder)
+            self._consult_admission(ten, cached=True)
             # chaos parity: cached traffic crosses the same serving
             # boundary (and burns the same SLO budgets) as executed
             # traffic

@@ -56,6 +56,7 @@ def _queue_depth() -> int:
     return sum(sum(len(dq) for dq in p.queues) + len(p.stream_queue)
                + len(p.batch_queue) + len(p.heavy_queue)
                + len(p.heavy_slices) + len(p.rebuild_queue)
+               + (len(f) if (f := p._fair) is not None else 0)
                for p in list(_POOLS))
 
 
@@ -75,6 +76,9 @@ def _lane_depth_series() -> dict:
         acc["heavy"] += len(p.heavy_queue) + len(p.heavy_slices)
         acc["stream"] += len(p.stream_queue)
         acc["rebuild"] += len(p.rebuild_queue)
+        f = p._fair  # the DRR sub-lane exists only once admission armed
+        if f is not None:
+            acc["fair"] = acc.get("fair", 0) + len(f)
     return {(k,): v for k, v in acc.items()}
 
 
@@ -106,6 +110,14 @@ def dead_engine_count() -> int:
     pool — a /healthz readiness input (obs/httpd.py health_report)."""
     return sum(1 for p in list(_POOLS) for t in range(p.n)
                if p._dead[t])  # unguarded: report-only snapshot, like health()
+
+
+def _live_engine_count() -> int:
+    """Engines NOT declared dead across every live pool — the admission
+    plane's derived in-flight capacity base (runtime/admission.py
+    ``_inflight_cap``: structural config, not a telemetry signal)."""
+    return sum(1 for p in list(_POOLS) for t in range(p.n)
+               if not p._dead[t])  # unguarded: report-only snapshot, like health()
 
 
 class EnginePool:
@@ -194,6 +206,13 @@ class EnginePool:
         # an open-loop poll() consumer (the emulator) sharing this pool
         # can't steal the stream context's completions
         self._stream_qids: set = set()  # guarded by: _results_lock
+        # weighted-fair sub-lane (runtime/admission.py FairQueue): created
+        # lazily on the first admission-armed submission so the off-knob
+        # pop path pays one attribute read, nothing else
+        self._fair = None  # guarded by: _route_lock
+        # heavy-lane slots currently held per tenant — the per-tenant
+        # weighted cap (admission heavy_cap_for) counts against this
+        self._heavy_by_tenant: dict = {}  # guarded by: _heavy_lock
         _POOLS.add(self)  # feeds the wukong_pool_queue_depth gauge
 
     # ------------------------------------------------------------------
@@ -324,6 +343,15 @@ class EnginePool:
                     self.queues[dst].append(item)
                 self._pending.release()
             if not live:  # nobody left to drain the stream lane either
+                # ...starting with the fair sub-lane: pop until dry (the
+                # DRR order is irrelevant now, every item fails the same)
+                f = self._fair
+                while f is not None:
+                    it = f.pop()
+                    if it is None:
+                        break
+                    self._end_queue_span(it[1], dead_pool=True)
+                    self._fail(it[0], RuntimeError("engine pool dead"))
                 with self._stream_lock:
                     stream_stranded = list(self.stream_queue)
                     self.stream_queue.clear()
@@ -416,6 +444,12 @@ class EnginePool:
                 "pool.queue", qid=qid, lane=lane or "default")
         self._stamp_enqueue(query, lane or "default")
         if lane == "stream":
+            if Global.enable_admission and getattr(query, "owner_tenant",
+                                                   None):
+                # priority inheritance: a standing query's maintenance
+                # work rides the fair sub-lane at its OWNER's weight
+                # instead of the last-priority stream lane
+                return self._submit_fair(qid, query, stream=True)
             with self._results_lock:
                 self._stream_qids.add(qid)
             with self._route_lock:
@@ -427,6 +461,11 @@ class EnginePool:
                     self.stream_queue.append((qid, query))
             self._pending.release()
             return qid
+        if tid is None and Global.enable_admission:
+            # default-lane traffic with no routing pin rides the DRR fair
+            # sub-lane: per-tenant sub-queues drained by weight, so a
+            # bulk flood cannot monopolize the interactive engines
+            return self._submit_fair(qid, query)
         t = qid % self.n if tid is None else tid % self.n
         with self._route_lock:  # atomic dead-check + enqueue vs declare-dead
             if self._dead[t]:  # route around dead engines
@@ -438,6 +477,35 @@ class EnginePool:
                 t = live[qid % len(live)]
             with self.locks[t]:
                 self.queues[t].append((qid, query))
+        self._pending.release()
+        return qid
+
+    def _submit_fair(self, qid: int, query, stream: bool = False) -> int:
+        """Enqueue into the weighted-fair sub-lane (admission armed).
+
+        The tenant is the EFFECTIVE one (``owner_tenant`` wins — priority
+        inheritance for standing-query maintenance) and the DRR weight is
+        resolved HERE, by the caller, from the lock-free quota map:
+        FairQueue never calls out under ``admission.queue``, keeping that
+        lock a lockdep leaf."""
+        from wukong_tpu.runtime.admission import (FairQueue,
+                                                  effective_tenant,
+                                                  get_admission)
+
+        ten = effective_tenant(query)
+        w = get_admission().weight(ten)
+        if stream:
+            with self._results_lock:
+                self._stream_qids.add(qid)
+        with self._route_lock:  # atomic dead-check + enqueue, as above
+            if all(self._dead[k] for k in range(self.n)):
+                self._end_queue_span(query, dead_pool=True)
+                self._fail(qid, RuntimeError("engine pool dead"))
+                return qid
+            f = self._fair
+            if f is None:
+                f = self._fair = FairQueue()
+            f.push(ten, (qid, query), weight=w)
         self._pending.release()
         return qid
 
@@ -497,8 +565,37 @@ class EnginePool:
         if getattr(query, "lane", None) != "heavy" \
                 or getattr(query, "heavy_continuation", False):
             return
+        ten = getattr(query, "_adm_heavy_ten", None)
         with self._heavy_lock:
             self._heavy_inflight = max(self._heavy_inflight - 1, 0)
+            if ten is not None:
+                query._adm_heavy_ten = None
+                left = self._heavy_by_tenant.get(ten, 1) - 1
+                if left <= 0:
+                    self._heavy_by_tenant.pop(ten, None)
+                else:
+                    self._heavy_by_tenant[ten] = left
+
+    def _heavy_pick_locked(self) -> int:  # caller holds: _heavy_lock
+        """Index of the first heavy-queue group whose tenant is under its
+        weighted per-tenant slot share, or -1 when every queued tenant is
+        at cap (caller holds ``_heavy_lock``). ``heavy_cap_for`` is a pure
+        function of the lock-free quota map — no lock is taken under the
+        heavy lock, so ``pool.heavy`` ordering is unchanged."""
+        if not Global.enable_admission:
+            return 0 if self.heavy_queue else -1
+        from wukong_tpu.runtime.admission import get_admission
+
+        adm = get_admission()
+        cap = self._heavy_cap()
+        for i, (_qid, g) in enumerate(self.heavy_queue):
+            ten = getattr(g, "tenant", None)
+            if ten is None:
+                return i  # untagged groups predate admission: no cap
+            if (self._heavy_by_tenant.get(ten, 0)
+                    < adm.heavy_cap_for(ten, cap, self._heavy_by_tenant)):
+                return i
+        return -1
 
     # ------------------------------------------------------------------
     def _neighbors(self, tid: int) -> list[int]:
@@ -519,6 +616,14 @@ class EnginePool:
         with self._batch_lock:
             if self.batch_queue:
                 return self.batch_queue.popleft()
+        # weighted-fair sub-lane (admission armed): one DRR pop serves the
+        # per-tenant sub-queues by weight — still interactive priority,
+        # ahead of stealing (a fair item has no owner engine to steal from)
+        f = self._fair  # unguarded: reads the set-once published reference
+        if f is not None:
+            item = f.pop()
+            if item is not None:
+                return item
         # steal from neighbors (back — leave the owner its freshest work)
         for nb in self._neighbors(tid):
             with self.locks[nb]:
@@ -533,8 +638,23 @@ class EnginePool:
             if self.heavy_slices:
                 return self.heavy_slices.popleft()
             if self.heavy_queue and self._heavy_inflight < self._heavy_cap():
-                self._heavy_inflight += 1
-                return self.heavy_queue.popleft()
+                i = self._heavy_pick_locked()
+                if i >= 0:
+                    item = self.heavy_queue[i]
+                    del self.heavy_queue[i]
+                    self._heavy_inflight += 1
+                    ten = getattr(item[1], "tenant", None)
+                    if ten is not None and Global.enable_admission:
+                        # stamp the counted tenant on the group so
+                        # _heavy_done releases the SAME slot even if the
+                        # knob or quota map changes mid-flight
+                        try:
+                            item[1]._adm_heavy_ten = ten
+                            self._heavy_by_tenant[ten] = (
+                                self._heavy_by_tenant.get(ten, 0) + 1)
+                        except AttributeError:
+                            pass  # __slots__ item: skip tenant accounting
+                    return item
         # stream lane next-to-last: standing-query work fills idle capacity
         with self._stream_lock:
             if self.stream_queue:

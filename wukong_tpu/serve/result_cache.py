@@ -124,7 +124,8 @@ class _Entry:
     needed to rebuild a byte-identical reply object."""
 
     __slots__ = ("version", "table", "v2c_map", "col_num", "nrows",
-                 "blind", "required_vars", "nvars", "nbytes", "t_us")
+                 "blind", "required_vars", "nvars", "nbytes", "t_us",
+                 "cost_us")
 
     def __init__(self, version: int, q) -> None:
         res = q.result
@@ -140,13 +141,18 @@ class _Entry:
         self.nvars = int(res.nvars)
         self.nbytes = int(table.nbytes) + 256  # metadata overhead
         self.t_us = get_usec()
+        # recompute cost: the leader's measured execution time (stamped
+        # by _Lease.settle), else a rows-based estimate — the cost-model
+        # admission bar and eviction scoring read this
+        self.cost_us = (max(float(q.__dict__.get("_exec_us", 0.0)), 0.0)
+                        or self.nrows * 2.0 + 50.0)
 
 
 class _Lease:
     """The leader's obligation: settle (fill on success, or just release)
     exactly once, waking every follower queued on the key."""
 
-    __slots__ = ("cache", "key", "version", "event", "_settled")
+    __slots__ = ("cache", "key", "version", "event", "_settled", "t0_us")
 
     def __init__(self, cache: "ResultCache", key, version: int,
                  event: threading.Event) -> None:
@@ -155,11 +161,17 @@ class _Lease:
         self.version = version
         self.event = event
         self._settled = False
+        self.t0_us = get_usec()  # recompute-cost clock (cost model)
 
     def settle(self, q) -> None:
         if self._settled:  # idempotent: finally-paths may double-call
             return
         self._settled = True
+        # the lease's lifetime IS the leader's execution: stamp the
+        # recompute cost for the fill's cost-model admission (unless an
+        # outer layer already measured it more precisely)
+        if "_exec_us" not in q.__dict__:
+            q._exec_us = get_usec() - self.t0_us
         try:
             self.cache.fill(self.key, self.version, q)
         finally:
@@ -358,6 +370,45 @@ class ResultCache:
     # ------------------------------------------------------------------
     # fills + admission
     # ------------------------------------------------------------------
+    @staticmethod
+    def _admit_bar(ent: "_Entry") -> int:
+        """The popularity bar this entry must clear, cost-weighted
+        (``result_cache_cost_model``): bytes held per microsecond of
+        recompute saved is the caching-benefit density — a bulky reply
+        that recomputes cheaply must prove 2-4x the popularity before it
+        may displace working-set bytes, while compact expensive entries
+        keep the base bar. Off-knob: the flat ``result_cache_min_reads``."""
+        base = max(int(Global.result_cache_min_reads), 0)
+        if not Global.result_cache_cost_model:
+            return base
+        density = ent.nbytes / max(ent.cost_us, 1.0)  # bytes per us saved
+        if density >= 4096.0:
+            return max(base, 1) * 4
+        if density >= 512.0:
+            return max(base, 1) * 2
+        return base
+
+    def _pick_victim_locked(self, keep):  # caller holds: _lock
+        """Eviction victim: pure LRU head off-knob; with the cost model
+        on, the LOWEST benefit score (recompute us per byte held) among
+        the 8 oldest entries — a cheap-to-recompute giant goes before an
+        expensive small entry even when slightly fresher. ``keep`` (the
+        just-filled key) is never chosen."""
+        it = (k for k in self._entries if k != keep)
+        victim = next(it)
+        if not Global.result_cache_cost_model:
+            return victim
+        best = (self._entries[victim].cost_us
+                / max(self._entries[victim].nbytes, 1))
+        for _ in range(7):
+            k = next(it, None)
+            if k is None:
+                break
+            s = self._entries[k].cost_us / max(self._entries[k].nbytes, 1)
+            if s < best:
+                victim, best = k, s
+        return victim
+
     def fill(self, key, version: int, q) -> bool:
         """Admit one executed reply (the leader's settlement path).
         Admission: SUCCESS + complete, the popularity ledger's verdict
@@ -374,17 +425,19 @@ class ResultCache:
             return False
         # the popularity/cacheability verdict, with THIS reply counted as
         # its own evidence (the ledger charges at the reply point, after
-        # this fill): reads+1 must clear the arrival bar, and a template
-        # never seen before is clean by definition
+        # this fill): reads+1 must clear the arrival bar — weighted by
+        # the entry's cost model (cheap-to-recompute giants must prove
+        # MORE popularity) — and a template never seen before is clean
+        # by definition
+        ent = _Entry(version, q)
         v = read_cache_input("template_popularity", template=key[0])
         unc = read_cache_input("uncacheable", template=key[0])
-        if (v["reads"] + 1 < max(int(Global.result_cache_min_reads), 0)
+        if (v["reads"] + 1 < self._admit_bar(ent)
                 or (v["reads"] > 0 and sum(unc.values()) > 0)):
             _C_REFUSED.inc()
             with self._lock:
                 self.refused += 1
             return False
-        ent = _Entry(version, q)
         cap = self._cap_bytes()
         if ent.nbytes > cap // 4:
             _C_REFUSED.inc()
@@ -401,7 +454,8 @@ class ResultCache:
             self.bytes_held += ent.nbytes
             self.fills += 1
             while self.bytes_held > cap and len(self._entries) > 1:
-                _k, dead = self._entries.popitem(last=False)
+                _k = self._pick_victim_locked(keep=key)
+                dead = self._entries.pop(_k)
                 self.bytes_held -= dead.nbytes
                 evicted += 1
             self.evicts += evicted

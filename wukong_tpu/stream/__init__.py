@@ -54,10 +54,10 @@ class StreamContext:
 
     # -- registry verbs -------------------------------------------------
     def register(self, query, window=None, base_triples=None,
-                 callback=None) -> int:
+                 callback=None, tenant=None) -> int:
         return self.continuous.register(query, window=window,
                                         base_triples=base_triples,
-                                        callback=callback)
+                                        callback=callback, tenant=tenant)
 
     def unregister(self, qid: int) -> None:
         self.continuous.unregister(qid)

@@ -208,6 +208,7 @@ class StandingQuery:
     base_triples: object = None  # static base included in window rebuilds
     support: object = None  # SupportIndex (windowed queries only)
     callback: object = None  # push-mode sink: fn(ResultDelta), exceptions contained
+    tenant: str = "default"  # owner — delta queries inherit it (admission)
     seen: set = field(default_factory=set)
     sink: list = field(default_factory=list)  # list[ResultDelta]
     epochs_evaluated: int = 0
@@ -261,7 +262,7 @@ class ContinuousEngine:
     # registration
     # ------------------------------------------------------------------
     def register(self, query, window=None, base_triples=None,
-                 callback=None) -> int:
+                 callback=None, tenant=None) -> int:
         """Register a standing query (SPARQL text or parsed SPARQLQuery).
 
         ``window`` (WindowSpec) scopes it to the live epochs only, evaluated
@@ -269,7 +270,11 @@ class ContinuousEngine:
         triples included in every window rebuild; ``callback`` is a
         push-mode sink invoked as ``callback(delta)`` per committed
         ResultDelta (including the registration snapshot) — exceptions are
-        contained and surfaced as a metric, never as a poisoned commit.
+        contained and surfaced as a metric, never as a poisoned commit;
+        ``tenant`` names the owner — its per-epoch delta queries are
+        stamped ``owner_tenant`` so the admission plane's weighted-fair
+        scheduling runs this maintenance work at the OWNER's weight
+        (priority inheritance), not the anonymous stream lane's.
         """
         if callback is not None and not callable(callback):
             raise WukongError(ErrorCode.UNSUPPORTED_SHAPE,
@@ -291,7 +296,8 @@ class ContinuousEngine:
             qid=qid, proto=copy.deepcopy(query), text=text, patterns=patterns,
             required_vars=list(query.result.required_vars),
             nvars=query.result.nvars, term_plans=term_plans,
-            callback=callback)
+            callback=callback,
+            tenant=(tenant or getattr(query, "tenant", None) or "default"))
         if window is not None:
             from wukong_tpu.stream.windows import (
                 EpochWindow,
@@ -611,6 +617,9 @@ class ContinuousEngine:
             res.add_var2col(v, col)
         res.set_table(seed)
         res.blind = True  # engines skip final-process; we project ourselves
+        # priority inheritance: the delta runs AS maintenance for its
+        # owner — the pool's fair sub-lane schedules it at that weight
+        q.owner_tenant = sq.tenant
         return q
 
     @staticmethod
