@@ -667,6 +667,199 @@ def serve_main(device_ok: bool) -> None:
             "must be zero-touch")
 
 
+def graphrag_main(device_ok: bool) -> None:
+    """`bench.py --graphrag`: the hybrid graph+vector serving benchmark
+    (wukong_tpu/vector/). Three measurements in one artifact:
+
+    - pure-scan kernel rate: brute-force k-NN over a >=100k x 128d
+      embedding block, XLA device route vs NumPy host route, as a GFLOP
+      rate + device/host ratio. When the ratio clears 3x the device
+      route carries wide scans; otherwise the measured-demotion drill
+      must engage cleanly (per-scan device failure falls back to host
+      with the demotion latched for the route memo) — one of the two is
+      the acceptance bar (WUKONG_GRAPHRAG_NOGATE=1 skips).
+    - hybrid q/s: Emulator.run_graphrag drives a Zipfian mixed workload
+      (pure graph 1-hops + knn()-seeded chains over LUBM professors)
+      through the live serving path — the headline.
+    - vectors-off zero-touch: the 2-hop serving micro interleaved with
+      enable_vectors off/on (query knn-free both ways); the p25..p75
+      latency bands must overlap — the vector plane may not tax graph
+      traffic.
+    Artifact: BENCH_GRAPHRAG.json."""
+    import numpy as np
+
+    from wukong_tpu.config import Global
+    from wukong_tpu.engine.cpu import CPUEngine
+    from wukong_tpu.engine.tpu import TPUEngine
+    from wukong_tpu.loader.datagen import make_vectors
+    from wukong_tpu.loader.lubm import UB
+    from wukong_tpu.planner.optimizer import Planner
+    from wukong_tpu.runtime.emulator import Emulator
+    from wukong_tpu.runtime.proxy import Proxy
+    from wukong_tpu.types import OUT
+    from wukong_tpu.utils.timer import get_usec
+    from wukong_tpu.vector import knn as vknn
+    from wukong_tpu.vector.vstore import VectorStore, upsert_batch_into
+
+    # ---- pure-scan kernel rate (standalone block, no graph needed) ----
+    N = int(os.environ.get("WUKONG_GRAPHRAG_N", "120000"))
+    D = int(os.environ.get("WUKONG_GRAPHRAG_DIM", "128"))
+    K, METRIC, REPS = 10, "cosine", 5
+    rng = np.random.default_rng(7)
+    block = rng.standard_normal((N, D)).astype(np.float32)
+    svids = np.arange(N, dtype=np.int64)
+    salive = np.ones(N, dtype=bool)
+    anchor = block[0].copy()
+    vknn.topk_device(svids, block, salive, anchor, K, METRIC)  # jit warm
+
+    def best_of(fn) -> float:
+        best = float("inf")
+        for _ in range(REPS):
+            t0 = get_usec()
+            fn()
+            best = min(best, (get_usec() - t0) / 1e6)
+        return best
+
+    t_host = best_of(lambda: vknn.topk_host(
+        svids, block, salive, anchor, K, METRIC))
+    t_dev = best_of(lambda: vknn.topk_device(
+        svids, block, salive, anchor, K, METRIC))
+    flops = 2.0 * N * D  # one dot product per candidate row
+    ratio = round(t_host / t_dev, 2) if t_dev > 0 else None
+    scan = {
+        "n": N, "dim": D, "k": K, "metric": METRIC,
+        "host_s": round(t_host, 6), "device_s": round(t_dev, 6),
+        "host_gflops": round(flops / t_host / 1e9, 2),
+        "device_gflops": round(flops / t_dev / 1e9, 2),
+        "device_vs_host": ratio,
+        "backend": "tpu" if device_ok else "cpu",
+    }
+
+    # ---- measured-demotion drill (the JOIN_ROUTES posture) ----
+    vs_small = VectorStore(0, 1, 16)
+    vs_small.upsert(np.arange(256, dtype=np.int64),
+                    rng.standard_normal((256, 16)).astype(np.float32))
+    want_v, want_s, _ = vknn.scan_topk(vs_small, np.asarray(
+        vs_small.get(0)), 5, METRIC, route="host")
+    prev_hook = vknn._DEVICE_FAIL_HOOK
+
+    def _boom():
+        raise RuntimeError("injected device failure (graphrag drill)")
+
+    try:
+        vknn._DEVICE_FAIL_HOOK = _boom
+        got_v, got_s, demoted = vknn.scan_topk(
+            vs_small, np.asarray(vs_small.get(0)), 5, METRIC,
+            route="device")
+    finally:
+        vknn._DEVICE_FAIL_HOOK = prev_hook
+    demotion_clean = bool(demoted is not None
+                          and np.array_equal(got_v, want_v)
+                          and np.allclose(got_s, want_s))
+
+    # ---- hybrid serving throughput (Zipfian GraphRAG mix) ----
+    scale = int(os.environ.get("WUKONG_BENCH_SCALE", "0")) or 1
+    g, ss, stats = _ensure_world(scale)
+    proxy = Proxy(g, ss, cpu_engine=CPUEngine(g, ss),
+                  tpu_engine=TPUEngine(g, ss, stats=stats),
+                  planner=Planner(stats))
+    pid = ss.str2id(f"<{UB}advisor>")
+    profs = np.unique(np.asarray(g.get_index(pid, OUT), dtype=np.int64))
+    Global.enable_vectors = True
+    prev_dim = Global.vector_dim
+    Global.vector_dim = 64
+    upsert_batch_into([g], profs, make_vectors(profs, 64))
+    graph_texts = [f"SELECT ?s WHERE {{ ?s <{UB}advisor> "
+                   f"{ss.id2str(int(a))} . }}" for a in profs[:256]]
+    hybrid_template = ("SELECT ?p ?d WHERE { knn(?p, {anchor}, 8) . "
+                      f"?p <{UB}worksFor> ?d }}")
+    anchors = [ss.id2str(int(a)) for a in profs[:64]]
+    dur = float(os.environ.get("WUKONG_GRAPHRAG_DURATION", "5"))
+    clients = int(os.environ.get("WUKONG_GRAPHRAG_CLIENTS", "8"))
+    emu = Emulator(proxy)
+    for t in graph_texts[:4]:
+        proxy.serve_query(t, blind=True)
+    proxy.serve_query(hybrid_template.replace("{anchor}", anchors[0]),
+                      blind=True)
+    mix = emu.run_graphrag(graph_texts, hybrid_template, anchors,
+                           duration_s=dur, warmup_s=1.0, clients=clients,
+                           seed=1)
+
+    # ---- vectors-off zero-touch on the 2-hop serving micro ----
+    two_hop = (f"SELECT ?x ?y WHERE {{ ?x <{UB}advisor> "
+               f"{ss.id2str(int(profs[0]))} . "
+               f"?x <{UB}memberOf> ?y . }}")
+    for _ in range(30):
+        proxy.serve_query(two_hop, blind=True)
+    lat = {"off": [], "on": []}
+    for _round in range(30):
+        for mode in ("off", "on"):
+            Global.enable_vectors = mode == "on"
+            for _ in range(10):
+                t0 = get_usec()
+                proxy.serve_query(two_hop, blind=True)
+                lat[mode].append(get_usec() - t0)
+    Global.enable_vectors = False
+    Global.vector_dim = prev_dim
+
+    def band(xs: list) -> dict:
+        xs = sorted(xs)
+        return {"p25_us": int(xs[len(xs) // 4]),
+                "p50_us": int(xs[len(xs) // 2]),
+                "p75_us": int(xs[(3 * len(xs)) // 4])}
+
+    b_off, b_on = band(lat["off"]), band(lat["on"])
+    bands_overlap = (b_off["p25_us"] <= b_on["p75_us"]
+                     and b_on["p25_us"] <= b_off["p75_us"])
+
+    _emit_final({
+        "metric": f"LUBM-{scale} GraphRAG hybrid serving throughput, "
+                  f"{clients} clients x {dur:.0f}s Zipfian graph+knn mix; "
+                  f"pure-scan {N//1000}k x {D}d device-vs-host "
+                  "detail + vectors-off zero-touch band",
+        "value": mix["hybrid"]["qps"],
+        "unit": "q/s",
+        "hybrid_qps": mix["hybrid"]["qps"],
+        "graph_qps": mix["graph"]["qps"],
+        "scan_device_vs_host": ratio,
+        "scan_device_gflops": scan["device_gflops"],
+        "demotion_clean": demotion_clean,
+        "backend": "tpu" if device_ok else "cpu",
+        "detail": {
+            "mix": mix,
+            "pure_scan": scan,
+            "demotion_drill": {
+                "engaged": demoted is not None,
+                "reason": demoted,
+                "host_identical": demotion_clean,
+            },
+            "vectors_off_overhead": {
+                "query": "2-hop chain micro, single-threaded, interleaved",
+                "samples_per_mode": len(lat["off"]),
+                "off": b_off, "on": b_on,
+                "bands_overlap": bands_overlap,
+            },
+            "knobs": {"vector_dim": 64, "knn_metric": METRIC,
+                      "knn_device": Global.knn_device,
+                      "knn_split_threshold": Global.knn_split_threshold,
+                      "clients": clients, "scale": scale},
+            "dataset": DATASET_NOTES["lubm"],
+        },
+    }, "BENCH_GRAPHRAG.json")
+    if os.environ.get("WUKONG_GRAPHRAG_NOGATE") == "1":
+        return
+    if not ((ratio is not None and ratio >= 3.0) or demotion_clean):
+        raise SystemExit(
+            f"graphrag drill FAILED: device route only {ratio}x host on "
+            f"the {N}x{D} scan AND the measured-demotion drill did not "
+            "engage cleanly — one of the two must hold")
+    if not bands_overlap:
+        raise SystemExit(
+            f"graphrag drill FAILED: enable_vectors off/on latency bands "
+            f"disjoint on the knn-free 2-hop micro (off={b_off}, "
+            f"on={b_on}) — the off knob must be zero-touch")
+
+
 def serve_mixed_main(device_ok: bool) -> None:
     """`bench.py --serve-mixed`: closed-loop MIXED light+heavy serving
     throughput (weighted LUBM light template + index-origin heavy
@@ -2637,6 +2830,9 @@ def main():
         return
     if "--serve-mixed" in sys.argv:
         serve_mixed_main(device_ok)
+        return
+    if "--graphrag" in sys.argv:
+        graphrag_main(device_ok)
         return
     if "--emu" in sys.argv:
         emu_main(device_ok)

@@ -45,6 +45,15 @@ SECONDARY_HEADLINES = (
     # admission overload drill — the throughput the plane preserves for
     # the top weight class while bulk is shed
     ("protected_qps", "q/s"),
+    # BENCH_GRAPHRAG's pure-scan device-vs-host ratio on the >=100k x
+    # 128d brute-force k-NN block (unit "x" is direction-less here: on a
+    # CPU-emulated backend the drill self-gates on the measured-demotion
+    # path instead, so the ratio is trended but never threshold-checked)
+    ("scan_device_vs_host", "x"),
+    # ...and the pure-graph q/s share of the same mixed GraphRAG loop,
+    # trended beside the hybrid headline so a vector-plane tax on graph
+    # traffic shows up as a divergence between the two series
+    ("graph_qps", "q/s"),
 )
 
 LOWER_BETTER = ("us", "ms", "ns", "sec")

@@ -45,6 +45,14 @@ echo "== tenant admission drill (2x-capacity overload ladder) =="
 # (exits non-zero otherwise; see tenants_main gates)
 JAX_PLATFORMS=cpu python bench.py --tenants
 
+echo "== graphrag hybrid drill (k-NN route + vectors-off zero-touch) =="
+# the hybrid graph+vector serving loop: pure-scan device route must
+# clear 3x host on the >=100k x 128d block OR the measured-demotion
+# drill must engage cleanly (device failure -> host-identical answer,
+# demotion latched), AND the enable_vectors off/on latency bands on the
+# knn-free 2-hop micro must overlap (exits non-zero otherwise)
+JAX_PLATFORMS=cpu python bench.py --graphrag
+
 echo "== bench trajectory check =="
 python scripts/bench_report.py --check
 

@@ -50,7 +50,7 @@ def test_plugin_registry():
         "fault-sites", "config-readme", "metrics-readme", "error-taxonomy",
         "heat-telemetry", "join-strategy", "slo-telemetry",
         "placement-telemetry", "migration-safety", "cache-coherence",
-        "admission-contract"}
+        "admission-contract", "vector-coherence"}
 
 
 def test_unknown_plugin_rejected():
@@ -667,3 +667,75 @@ def test_cache_gate_observe_only_tree_skips_serve_checks(tmp_path):
             "def recover():\n"
             "    maybe_note_invalidation('restore')\n")})
     assert run_analysis(tree, plugins=["cache-coherence"]) == []
+
+
+# ---------------------------------------------------------------------------
+# vector-coherence gate: the hybrid graph+vector plane
+# ---------------------------------------------------------------------------
+
+def test_vector_gate_fixtures(tmp_path):
+    """Declared VECTOR_METRICS must be registered (and vice versa for
+    wukong_vector_* names), slot state is written only by the declared
+    writers with a version bump, module mutation paths bump the store
+    version, vector locks are leaves, and shared state is annotated."""
+    from wukong_tpu.analysis import run_analysis
+
+    bad = write_tree(tmp_path / "bad", {
+        "vector/__init__.py": (
+            "VECTOR_METRICS = {'upserts': 'wukong_vector_up_total',"
+            " 'phantom': 'wukong_vector_ghost_total'}\n"),
+        "vector/vstore.py": (
+            "def reg(r):\n"
+            "    r.counter('wukong_vector_up_total', 'h')\n"
+            "    r.counter('wukong_vector_rogue_total', 'h')\n"
+            "class VectorStore:\n"
+            "    def __init__(self):\n"
+            "        self.slot_of = {}\n"
+            "        self._lock = make_lock('vector.slots')\n"
+            "    def _apply_slots(self, vids):\n"
+            "        with self._lock:\n"
+            "            self.vids = vids\n"
+            "    def refresh(self):\n"
+            "        self.alive = None\n"
+            "def apply_batch(g, vs):\n"
+            "    return vs.upsert([1])\n")})
+    out = run_analysis(bad, plugins=["vector-coherence"])
+    msgs = "\n".join(str(v) for v in out)
+    assert "wukong_vector_ghost_total" in msgs  # declared, never registered
+    assert "wukong_vector_rogue_total" in msgs  # registered, undeclared
+    assert "refresh() writes slot state" in msgs
+    assert "never bumps `.version`" in msgs
+    assert "apply_batch() applies a vector mutation" in msgs
+    assert "vector.slots" in msgs              # undeclared leaf lock
+    assert "VectorStore.slot_of" in msgs       # unannotated shared state
+
+    good = write_tree(tmp_path / "good", {
+        "vector/__init__.py": (
+            "VECTOR_METRICS = {'upserts': 'wukong_vector_up_total'}\n"),
+        "vector/vstore.py": (
+            "declare_leaf('vector.slots')\n"
+            "def reg(r):\n"
+            "    r.counter('wukong_vector_up_total', 'h')\n"
+            "class VectorStore:\n"
+            "    def __init__(self):\n"
+            "        self.slot_of = {}  # guarded by: _lock\n"
+            "        self._lock = make_lock('vector.slots')\n"
+            "    def _apply_slots(self, vids):\n"
+            "        with self._lock:\n"
+            "            self.vids = vids\n"
+            "            self.version += 1\n"
+            "def apply_batch(g, vs):\n"
+            "    n = vs.upsert([1])\n"
+            "    bump_store_version(g)\n"
+            "    return n\n")})
+    assert run_analysis(good, plugins=["vector-coherence"]) == []
+
+
+def test_vector_gate_skips_trees_without_vector_plane(tmp_path):
+    """Pre-vector trees (and foreign packages) are not required to grow
+    a VECTOR_METRICS registry."""
+    from wukong_tpu.analysis import run_analysis
+
+    tree = write_tree(tmp_path / "plain", {
+        "store/gstore.py": "def build():\n    return 1\n"})
+    assert run_analysis(tree, plugins=["vector-coherence"]) == []

@@ -643,6 +643,81 @@ def test_recover_falls_back_to_older_checkpoint_on_corrupt_parts(
     assert _segment_bytes(g) == _segment_bytes(g2)
 
 
+def test_vstore_checkpoint_plus_wal_tail_is_byte_identical(lubm_world,
+                                                           tmp_path):
+    """The vector plane rides the same recovery contract as triples: a
+    checkpoint carrying the embedding block + a 'vector' WAL tail
+    (upsert AND tombstone records) replays to a store whose embeddings
+    are BYTE-identical to an uninterrupted oracle run — and a k-NN scan
+    over the recovered store returns exactly the oracle's answer."""
+    from wukong_tpu.loader.datagen import make_vectors
+    from wukong_tpu.vector import knn as vknn
+    from wukong_tpu.vector.vstore import attach_vstore, upsert_batch_into
+
+    triples, ss = lubm_world
+    DIM = 8
+    ids_a = np.arange(70000, 70050, dtype=np.int64)
+    ids_b = np.arange(70025, 70070, dtype=np.int64)  # overlap rewrites
+
+    # ---- oracle: uninterrupted, no durability machinery ----
+    g_o = build_partition(triples, 0, 1)
+    attach_vstore(g_o, DIM)
+    upsert_batch_into([g_o], ids_a, make_vectors(ids_a, DIM))
+    upsert_batch_into([g_o], ids_b, make_vectors(ids_b, DIM, seed=5))
+    upsert_batch_into([g_o], ids_a[::4], tombstone=True)
+    anchor = np.asarray(g_o.vstore.get(70060))
+    want_v, want_s, _ = vknn.scan_topk(g_o.vstore, anchor, 10, "cosine")
+
+    # ---- durable run: checkpoint after batch 1, crash after the tail ----
+    Global.wal_dir = str(tmp_path / "wal")
+    Global.checkpoint_dir = str(tmp_path / "ckpt")
+    reset_wal()
+    g_c = build_partition(triples, 0, 1)
+    attach_vstore(g_c, DIM)
+    upsert_batch_into([g_c], ids_a, make_vectors(ids_a, DIM))
+    RecoveryManager([g_c]).checkpoint()
+    upsert_batch_into([g_c], ids_b, make_vectors(ids_b, DIM, seed=5))
+    upsert_batch_into([g_c], ids_a[::4], tombstone=True)
+    del g_c  # abandon the objects, as a process kill would
+
+    # ---- restart: fresh world, checkpoint + vector WAL tail ----
+    g_r = build_partition(triples, 0, 1)
+    stats = RecoveryManager([g_r]).recover()
+    assert stats["checkpoint"] is not None
+    assert stats["replayed"]["vector"] == 2  # batch 2 + the tombstones
+    vo, vr = g_o.vstore, g_r.vstore
+    assert vr.digest() == vo.digest()  # slot layout + bytes identical
+    assert np.array_equal(vr.vids, vo.vids)
+    assert vr.vecs.tobytes() == vo.vecs.tobytes()
+    assert np.array_equal(vr.alive, vo.alive)
+    assert vr.live_count() == vo.live_count()
+    got_v, got_s, _ = vknn.scan_topk(vr, anchor, 10, "cosine")
+    assert np.array_equal(got_v, want_v)
+    assert got_s.tobytes() == want_s.tobytes()  # same kernel, same bytes
+
+
+def test_recover_without_checkpoint_replays_vector_records(lubm_world,
+                                                           tmp_path):
+    """No checkpoint at all: the full-WAL path must rebuild the vstore
+    from its 'vector' records alone (Global.enable_vectors stays off —
+    replay must not depend on the serving knob)."""
+    from wukong_tpu.loader.datagen import make_vectors
+    from wukong_tpu.vector.vstore import attach_vstore, upsert_batch_into
+
+    triples, ss = lubm_world
+    Global.wal_dir = str(tmp_path / "wal")
+    reset_wal()
+    g1 = build_partition(triples, 0, 1)
+    attach_vstore(g1, 8)
+    vids = np.arange(70000, 70030, dtype=np.int64)
+    upsert_batch_into([g1], vids, make_vectors(vids, 8))
+    g2 = build_partition(triples, 0, 1)
+    stats = RecoveryManager([g2]).recover()
+    assert stats["checkpoint"] is None
+    assert stats["replayed"]["vector"] == 1
+    assert g2.vstore.digest() == g1.vstore.digest()
+
+
 def test_stream_registry_state_roundtrip(lubm_world):
     triples, ss = lubm_world
     g = build_partition(triples, 0, 1)

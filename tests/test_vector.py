@@ -1,0 +1,609 @@
+"""Hybrid graph+vector subsystem (wukong_tpu/vector/): vstore semantics,
+the batched k-NN operator's route identity, knn() composition with BGPs,
+the serving-path integration, and the durability seams.
+
+Acceptance surface (ISSUE 17):
+
+- k-NN results exact vs a NumPy brute-force oracle on all three metrics,
+  including the canonical ``(score desc, vid asc)`` tie policy;
+- both composition directions (rank-then-pattern / pattern-then-rank)
+  byte-identical between the host and device routes, and between the CPU
+  and device engines;
+- a device-path failure demotes to the host kernels with the answer
+  intact and the template's memoized route flipped to host;
+- the ``vector.upsert`` fault site fires BEFORE the WAL append — an
+  injected failure leaves the WAL and every vstore untouched;
+- ``enable_vectors off`` refuses knn() and leaves the graph path
+  zero-touch;
+- migration dual-write sinks mirror vector batches.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from wukong_tpu.config import Global
+from wukong_tpu.engine.cpu import CPUEngine
+from wukong_tpu.engine.tpu import TPUEngine
+from wukong_tpu.loader.datagen import (
+    CyclicStrings,
+    generate_triangle,
+    make_vectors,
+)
+from wukong_tpu.runtime import faults
+from wukong_tpu.runtime.faults import FaultPlan, FaultSpec, TransientFault
+from wukong_tpu.runtime.proxy import Proxy
+from wukong_tpu.sparql.parser import Parser, SPARQLSyntaxError
+from wukong_tpu.store.gstore import build_partition
+from wukong_tpu.store.wal import active_wal, reset_wal
+from wukong_tpu.types import NORMAL_ID_START
+from wukong_tpu.utils.errors import ErrorCode, WukongError
+from wukong_tpu.vector import knn as vknn
+from wukong_tpu.vector.vstore import (
+    VectorStore,
+    apply_vector_record,
+    attach_vstore,
+    upsert_batch_into,
+)
+
+pytestmark = pytest.mark.vector
+
+DIM = 8
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _lockdep_checked():
+    """The vector plane introduces two leaf locks (vector.slots /
+    vector.slice); the whole suite runs under the lockdep checker so
+    every scan/upsert doubles as a lock-order regression test."""
+    from wukong_tpu.analysis import lockdep
+
+    lockdep.install(True)
+    yield
+    try:
+        assert lockdep.cycles() == [], lockdep.cycles()
+        assert lockdep.leaf_violations() == [], lockdep.leaf_violations()
+    finally:
+        lockdep.install(False)
+
+
+@pytest.fixture(autouse=True)
+def _clean_knobs():
+    faults.clear()
+    yield
+    faults.clear()
+    Global.enable_vectors = False
+    Global.vector_dim = 64
+    Global.knn_metric = "cosine"
+    Global.knn_device = "auto"
+    Global.knn_split_threshold = 65536
+    Global.wal_dir = ""
+    reset_wal()
+    vknn._DEVICE_FAIL_HOOK = None
+
+
+@pytest.fixture(scope="module")
+def tri_world():
+    triples, meta = generate_triangle(64, noise=2, seed=1)
+    return triples, meta
+
+
+def _hybrid_world(tri_world):
+    """A fresh single-partition triangle world with every vertex
+    embedded (id-keyed clustered vectors) and both engines attached."""
+    triples, meta = tri_world
+    g = build_partition(triples, 0, 1)
+    ss = CyclicStrings(meta)
+    attach_vstore(g, DIM)
+    vids = np.arange(NORMAL_ID_START, NORMAL_ID_START + 192,
+                     dtype=np.int64)
+    upsert_batch_into([g], vids, make_vectors(vids, DIM))
+    proxy = Proxy(g, ss, cpu_engine=CPUEngine(g, ss),
+                  tpu_engine=TPUEngine(g, ss))
+    return g, ss, proxy
+
+
+def _rand_store(n=300, dim=DIM, seed=3, dead_every=7):
+    rng = np.random.default_rng(seed)
+    vs = VectorStore(0, 1, dim)
+    vids = np.arange(n, dtype=np.int64)
+    vs.upsert(vids, rng.standard_normal((n, dim)).astype(np.float32))
+    vs.tombstone(vids[::dead_every])
+    return vs
+
+
+def _oracle_topk(vs, anchor, k, metric):
+    """Independent brute-force oracle (different formulation from
+    knn.scores on purpose: per-row python loop, l2 as ascending
+    distance)."""
+    vids, vecs, alive, _v = vs.snapshot()
+    anchor = np.asarray(anchor, dtype=np.float64)
+    out = []
+    for vid, vec, ok in zip(vids, vecs, alive):
+        if not ok:
+            continue
+        v = vec.astype(np.float64)
+        if metric == "dot":
+            s = float(v @ anchor)
+        elif metric == "cosine":
+            s = float((v @ anchor)
+                      / max(np.linalg.norm(v) * np.linalg.norm(anchor),
+                            1e-12))
+        else:  # l2, ranked by negative squared distance
+            s = -float(np.sum((v - anchor) ** 2))
+        out.append((s, int(vid)))
+    out.sort(key=lambda t: (-t[0], t[1]))
+    return np.asarray([vid for _s, vid in out[:k]], dtype=np.int64)
+
+
+# ---------------------------------------------------------------------------
+# kernel parity: host oracle, device identity, tie policy
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("metric", vknn.KNN_METRICS)
+def test_topk_host_matches_bruteforce_oracle(metric):
+    vs = _rand_store()
+    anchor = np.asarray(vs.get(1))
+    got_v, got_s = vknn.topk_host(*vs.snapshot()[:3], anchor, 10, metric)
+    assert np.array_equal(got_v, _oracle_topk(vs, anchor, 10, metric))
+    assert np.all(np.diff(got_s.astype(np.float64)) <= 1e-6)  # desc
+
+
+@pytest.mark.parametrize("metric", vknn.KNN_METRICS)
+def test_topk_device_byte_identical_to_host(metric):
+    vs = _rand_store(n=257)  # straddles a pad_pow2 capacity boundary
+    anchor = np.asarray(vs.get(2))
+    hv, hs = vknn.topk_host(*vs.snapshot()[:3], anchor, 12, metric)
+    dv, ds = vknn.topk_device(*vs.snapshot()[:3], anchor, 12, metric)
+    assert np.array_equal(hv, dv)
+    assert np.allclose(hs, ds, rtol=1e-5, atol=1e-5)
+
+
+def test_tie_break_vid_ascending_on_both_routes():
+    # 16 identical vectors: every score ties, so the canonical order is
+    # pure vid-ascending — on BOTH kernels
+    vs = VectorStore(0, 1, DIM)
+    vids = np.asarray([40, 7, 23, 1, 99, 5, 60, 2,
+                       81, 3, 12, 44, 9, 71, 30, 18], dtype=np.int64)
+    vs.upsert(vids, np.ones((16, DIM), dtype=np.float32))
+    want = np.sort(vids)[:6]
+    anchor = np.ones(DIM, dtype=np.float32)
+    for fn in (vknn.topk_host, vknn.topk_device):
+        got_v, _ = fn(*vs.snapshot()[:3], anchor, 6, "cosine")
+        assert np.array_equal(got_v, want), fn.__name__
+
+
+def test_topk_excludes_tombstoned_and_caps_k():
+    vs = _rand_store(n=20, dead_every=2)  # 10 live slots
+    anchor = np.asarray(vs.get(1))
+    got_v, _ = vknn.topk_host(*vs.snapshot()[:3], anchor, 50, "dot")
+    assert len(got_v) == 10  # k capped at the live population
+    assert not (set(got_v.tolist()) & set(range(0, 20, 2)))
+
+
+# ---------------------------------------------------------------------------
+# route seam: demotion, slicing
+# ---------------------------------------------------------------------------
+
+def test_scan_topk_demotes_device_failure_to_host():
+    vs = _rand_store()
+    anchor = np.asarray(vs.get(4))
+    want_v, want_s, none = vknn.scan_topk(vs, anchor, 5, "cosine",
+                                          route="host")
+    assert none is None
+
+    def boom():
+        raise RuntimeError("injected device failure")
+
+    vknn._DEVICE_FAIL_HOOK = boom
+    try:
+        got_v, got_s, demoted = vknn.scan_topk(vs, anchor, 5, "cosine",
+                                               route="device")
+    finally:
+        vknn._DEVICE_FAIL_HOOK = None
+    assert demoted == "RuntimeError"
+    assert np.array_equal(got_v, want_v)
+    assert np.allclose(got_s, want_s)
+
+
+class _InlinePool:
+    """Minimal heavy-lane pool: runs each submitted slice on a thread
+    (the claim/gather barrier is what's under test, not scheduling)."""
+
+    def __init__(self):
+        self.submitted = 0
+
+    def submit(self, item, lane=None):
+        assert lane == "heavy"
+        self.submitted += 1
+        threading.Thread(target=item.run, daemon=True).start()
+
+
+@pytest.mark.parametrize("parts", [2, 5])
+def test_sliced_topk_equals_single_scan(parts):
+    vs = _rand_store(n=400)
+    anchor = np.asarray(vs.get(8))
+    want_v, want_s, _ = vknn.scan_topk(vs, anchor, 9, "l2", route="host")
+    pool = _InlinePool()
+    got_v, got_s, demoted = vknn.sliced_topk(pool, vs, anchor, 9, "l2",
+                                             "host", parts)
+    assert pool.submitted == parts - 1  # gather thread works slice 0
+    assert demoted is None
+    assert np.array_equal(got_v, want_v)
+    assert np.allclose(got_s, want_s)
+
+
+def test_sliced_topk_per_slice_device_fallback():
+    vs = _rand_store(n=200)
+    anchor = np.asarray(vs.get(8))
+    want_v, _, _ = vknn.scan_topk(vs, anchor, 7, "cosine", route="host")
+
+    def boom():
+        raise RuntimeError("slice device failure")
+
+    vknn._DEVICE_FAIL_HOOK = boom
+    try:
+        got_v, _, demoted = vknn.sliced_topk(_InlinePool(), vs, anchor,
+                                             7, "cosine", "device", 3)
+    finally:
+        vknn._DEVICE_FAIL_HOOK = None
+    assert demoted == "RuntimeError"  # latched for the proxy's feedback
+    assert np.array_equal(got_v, want_v)
+
+
+# ---------------------------------------------------------------------------
+# vstore semantics
+# ---------------------------------------------------------------------------
+
+def test_vstore_upsert_dedup_tombstone_revive():
+    vs = VectorStore(0, 1, DIM)
+    v0 = np.zeros((1, DIM), dtype=np.float32)
+    v1 = np.ones((1, DIM), dtype=np.float32)
+    # in-batch dedup: the LAST occurrence wins (upsert semantics)
+    vs.upsert([5, 5], np.concatenate([v0, v1]))
+    assert vs.n_slots() == 1 and np.array_equal(np.asarray(vs.get(5)),
+                                                v1[0])
+    ver = vs.version
+    vs.tombstone([5])
+    assert vs.get(5) is None and vs.live_count() == 0
+    assert vs.version == ver + 1
+    vs.upsert([5], v0)  # revive in place: no new slot
+    assert vs.n_slots() == 1 and np.array_equal(np.asarray(vs.get(5)),
+                                                v0[0])
+
+
+def test_vstore_ownership_filter_partitions_like_triples():
+    stores = [VectorStore(sid, 4, DIM) for sid in range(4)]
+    vids = np.arange(100, dtype=np.int64)
+    vecs = np.ones((100, DIM), dtype=np.float32)
+    written = [vs.upsert(vids, vecs) for vs in stores]
+    assert sum(written) == 100  # exact partition, no overlap
+    assert all(w > 0 for w in written)
+
+
+def test_vstore_snapshot_arrays_immutable_and_stable():
+    vs = _rand_store(n=50)
+    vids, vecs, alive, ver = vs.snapshot()
+    with pytest.raises((ValueError, RuntimeError)):
+        vecs[0, 0] = 99.0
+    vs.upsert([500], np.zeros((1, DIM), dtype=np.float32))
+    # the racing upsert published NEW arrays; the held snapshot is intact
+    assert len(vids) == 50 and vs.n_slots() == 51
+    assert vs.snapshot()[3] == ver + 1
+
+
+def test_vstore_rejects_dim_mismatch_and_bad_ids():
+    vs = VectorStore(0, 1, DIM)
+    with pytest.raises(WukongError):
+        vs.upsert([1], np.zeros((1, DIM + 1), dtype=np.float32))
+    with pytest.raises(WukongError):
+        upsert_batch_into([], np.asarray([-1]),
+                          np.zeros((1, DIM), dtype=np.float32))
+
+
+def test_wal_replayed_store_digest_identical(tmp_path):
+    Global.wal_dir = str(tmp_path)
+    reset_wal()
+    g = build_partition(np.asarray([[NORMAL_ID_START, 2,
+                                     NORMAL_ID_START + 1]],
+                                   dtype=np.int64), 0, 1)
+    attach_vstore(g, DIM)
+    vids = np.arange(NORMAL_ID_START, NORMAL_ID_START + 40,
+                     dtype=np.int64)
+    upsert_batch_into([g], vids, make_vectors(vids, DIM))
+    upsert_batch_into([g], vids[::3], tombstone=True)
+    recs = [r for r in active_wal().replay() if r.kind == "vector"]
+    assert len(recs) == 2
+    g2 = build_partition(np.asarray([[NORMAL_ID_START, 2,
+                                      NORMAL_ID_START + 1]],
+                                    dtype=np.int64), 0, 1)
+    for r in recs:  # replay attaches on demand (fresh-world contract)
+        apply_vector_record(g2, r.payload)
+    assert g2.vstore.digest() == g.vstore.digest()
+    assert g2.vstore.live_count() == g.vstore.live_count()
+
+
+# ---------------------------------------------------------------------------
+# the vector.upsert fault site (KNOWN_FAULT_SITES chaos drill)
+# ---------------------------------------------------------------------------
+
+def test_vector_upsert_fault_leaves_wal_and_vstore_untouched(tmp_path):
+    """The 'vector.upsert' site fires BEFORE the WAL append: an injected
+    failure must leave the WAL record count AND every vstore byte
+    untouched — the batch was never acknowledged, so there is nothing to
+    replay and nothing to roll back."""
+    Global.wal_dir = str(tmp_path)
+    reset_wal()
+    g = build_partition(np.asarray([[NORMAL_ID_START, 2,
+                                     NORMAL_ID_START + 1]],
+                                   dtype=np.int64), 0, 1)
+    attach_vstore(g, DIM)
+    vids = np.arange(NORMAL_ID_START, NORMAL_ID_START + 20,
+                     dtype=np.int64)
+    upsert_batch_into([g], vids, make_vectors(vids, DIM))
+    digest0 = g.vstore.digest()
+    vver0 = g.vstore.version
+    gver0 = g.version
+    wal_count0 = len(list(active_wal().replay()))
+
+    faults.install(FaultPlan([FaultSpec("vector.upsert", "transient")],
+                             seed=0))
+    with pytest.raises(TransientFault):
+        upsert_batch_into([g], vids, make_vectors(vids, DIM, seed=9))
+    faults.clear()
+
+    assert len(list(active_wal().replay())) == wal_count0
+    assert g.vstore.digest() == digest0
+    assert g.vstore.version == vver0 and g.version == gver0
+    # the plan is gone: the same batch now commits durably
+    assert upsert_batch_into([g], vids,
+                             make_vectors(vids, DIM, seed=9)) == 20
+    assert len(list(active_wal().replay())) == wal_count0 + 1
+
+
+# ---------------------------------------------------------------------------
+# migration dual-write
+# ---------------------------------------------------------------------------
+
+def test_migration_sink_mirrors_vector_batches():
+    from wukong_tpu.store.dynamic import (
+        deroll_migration_sink,
+        enroll_migration_sink,
+    )
+    from wukong_tpu.store.wal import mutation_lock
+
+    g1 = build_partition(np.asarray([[NORMAL_ID_START, 2,
+                                      NORMAL_ID_START + 1]],
+                                    dtype=np.int64), 0, 1)
+    g2 = build_partition(np.asarray([[NORMAL_ID_START, 2,
+                                      NORMAL_ID_START + 1]],
+                                    dtype=np.int64), 0, 1)
+    attach_vstore(g1, DIM)
+    with mutation_lock():
+        enroll_migration_sink("test-vector-sink", g2)
+    try:
+        vids = np.arange(NORMAL_ID_START, NORMAL_ID_START + 16,
+                         dtype=np.int64)
+        total = upsert_batch_into([g1], vids, make_vectors(vids, DIM))
+        assert total == 16  # the sink mirror is excluded from the count
+    finally:
+        with mutation_lock():
+            deroll_migration_sink("test-vector-sink")
+    assert getattr(g2, "vstore", None) is not None  # attach-on-demand
+    assert g2.vstore.digest() == g1.vstore.digest()
+
+
+# ---------------------------------------------------------------------------
+# parser: the knn() clause
+# ---------------------------------------------------------------------------
+
+def _parse(ss, text):
+    return Parser(ss).parse(text)
+
+
+def test_parser_knn_iri_anchor_and_modes(tri_world):
+    ss = CyclicStrings(tri_world[1])
+    q = _parse(ss, "SELECT ?a ?b WHERE { knn(?a, <urn:cyc:v:0>, 5) . "
+                   "?a <urn:cyc:p:p1> ?b }")
+    assert q.knn is not None and q.knn.k == 5
+    assert q.knn.anchor_vid == NORMAL_ID_START
+    assert q.knn.mode == "rank_then_pattern"
+    q = _parse(ss, "SELECT ?a ?b WHERE { ?a <urn:cyc:p:p1> ?b . "
+                   "knn(?a, <urn:cyc:v:0>, 5) }")
+    assert q.knn.mode == "pattern_then_rank"
+    q = _parse(ss, "SELECT ?a WHERE { knn(?a, <urn:cyc:v:3>, 7, l2) }")
+    assert q.knn.mode == "scan" and q.knn.metric == "l2"
+
+
+def test_parser_knn_literal_vector_anchor(tri_world):
+    ss = CyclicStrings(tri_world[1])
+    q = _parse(ss, "SELECT ?a WHERE { knn(?a, (0.5 -1 0.25), 3, dot) }")
+    assert q.knn.anchor_vid is None
+    assert np.allclose(q.knn.anchor_vec, [0.5, -1.0, 0.25])
+
+
+@pytest.mark.parametrize("bad", [
+    # two clauses
+    "SELECT ?a WHERE { knn(?a, <urn:cyc:v:0>, 5) . "
+    "knn(?a, <urn:cyc:v:1>, 5) }",
+    # k < 1
+    "SELECT ?a WHERE { knn(?a, <urn:cyc:v:0>, 0) }",
+    # unknown metric
+    "SELECT ?a WHERE { knn(?a, <urn:cyc:v:0>, 5, manhattan) }",
+    # empty literal vector
+    "SELECT ?a WHERE { knn(?a, (), 5) }",
+    # nested group
+    "SELECT ?a ?b WHERE { { knn(?a, <urn:cyc:v:0>, 5) . "
+    "?a <urn:cyc:p:p1> ?b } UNION { ?a <urn:cyc:p:p2> ?b } }",
+])
+def test_parser_knn_refusals(tri_world, bad):
+    ss = CyclicStrings(tri_world[1])
+    with pytest.raises(SPARQLSyntaxError):
+        _parse(ss, bad)
+
+
+# ---------------------------------------------------------------------------
+# composition through the serving path: modes, routes, engines
+# ---------------------------------------------------------------------------
+
+Q_RANK_THEN_PATTERN = ("SELECT ?a ?b WHERE { knn(?a, <urn:cyc:v:0>, 6) "
+                       ". ?a <urn:cyc:p:p1> ?b }")
+Q_PATTERN_THEN_RANK = ("SELECT ?a ?b WHERE { ?a <urn:cyc:p:p1> ?b . "
+                       "knn(?a, <urn:cyc:v:0>, 6) }")
+Q_SCAN = "SELECT ?a WHERE { knn(?a, <urn:cyc:v:0>, 6) }"
+
+
+@pytest.mark.parametrize("text,mode", [
+    (Q_RANK_THEN_PATTERN, "rank_then_pattern"),
+    (Q_PATTERN_THEN_RANK, "pattern_then_rank"),
+    (Q_SCAN, "scan"),
+])
+def test_compositions_byte_identical_across_routes_and_engines(
+        tri_world, text, mode):
+    g, ss, proxy = _hybrid_world(tri_world)
+    Global.enable_vectors = True
+    tables = {}
+    for route in ("host", "device"):
+        Global.knn_device = route
+        for device in ("cpu", "tpu"):
+            q = proxy.serve_query(text, blind=False, device=device)
+            assert q.result.status_code == ErrorCode.SUCCESS
+            assert q.knn_mode == mode
+            assert q.knn_route == route
+            tables[(route, device)] = np.array(q.result.table)
+    base = tables[("host", "cpu")]
+    assert base.size  # the composition produced rows
+    for key, table in tables.items():
+        assert np.array_equal(table, base), key
+
+
+def test_rank_then_pattern_restricts_to_topk_seeds(tri_world):
+    g, ss, proxy = _hybrid_world(tri_world)
+    Global.enable_vectors = True
+    anchor = np.asarray(g.vstore.get(NORMAL_ID_START))
+    seeds, _s, _d = vknn.scan_topk(g.vstore, anchor, 6, "cosine")
+    q = proxy.serve_query(Q_RANK_THEN_PATTERN, blind=False)
+    got_a = set(q.result.table[:, q.result.var2col(-1)].tolist())
+    assert got_a and got_a <= set(seeds.tolist())
+
+
+def test_pattern_then_rank_filters_binding_set(tri_world):
+    g, ss, proxy = _hybrid_world(tri_world)
+    Global.enable_vectors = True
+    plain = proxy.serve_query("SELECT ?a ?b WHERE "
+                              "{ ?a <urn:cyc:p:p1> ?b }", blind=False)
+    ranked = proxy.serve_query(Q_PATTERN_THEN_RANK, blind=False)
+    col = ranked.result.var2col(-1)
+    kept = set(ranked.result.table[:, col].tolist())
+    assert 0 < len(kept) <= 6  # at most k distinct survivors
+    assert ranked.result.table.shape[0] < plain.result.table.shape[0]
+
+
+def test_knn_refused_when_vectors_off(tri_world):
+    g, ss, proxy = _hybrid_world(tri_world)
+    assert Global.enable_vectors is False
+    with pytest.raises(WukongError) as ei:
+        proxy.serve_query(Q_SCAN, blind=True)
+    assert ei.value.code == ErrorCode.ATTR_DISABLE
+
+
+def test_vectors_off_graph_path_zero_touch(tri_world):
+    """With the knob off, a knn-free graph query must touch nothing in
+    the vector plane: identical reply bytes and frozen wukong_vector_*
+    counters."""
+    from wukong_tpu.obs.metrics import get_registry
+
+    g, ss, proxy = _hybrid_world(tri_world)
+    text = "SELECT ?a ?b WHERE { ?a <urn:cyc:p:p1> ?b }"
+    reg = get_registry()
+
+    def vec_counts():
+        return {n: [s.get("value", s.get("count"))
+                    for s in fam["series"]]
+                for n, fam in reg.snapshot().items()
+                if n.startswith("wukong_vector_")}
+
+    Global.enable_vectors = True
+    on = proxy.serve_query(text, blind=False)
+    before = vec_counts()
+    Global.enable_vectors = False
+    off = proxy.serve_query(text, blind=False)
+    assert vec_counts() == before
+    assert np.array_equal(on.result.table, off.result.table)
+
+
+def test_device_demotion_feedback_pins_route_to_host(tri_world):
+    """knn_device auto + a device failure: the engine latches the
+    demotion, the proxy flips the template's memoized route to host, and
+    the SAME template's next query plans route=host up front."""
+    g, ss, proxy = _hybrid_world(tri_world)
+    Global.enable_vectors = True
+    Global.knn_device = "auto"
+    Global.knn_split_threshold = 1  # every scan is "wide enough" for device
+
+    def boom():
+        raise RuntimeError("injected device failure")
+
+    vknn._DEVICE_FAIL_HOOK = boom
+    try:
+        q = proxy.serve_query(Q_RANK_THEN_PATTERN, blind=False)
+    finally:
+        vknn._DEVICE_FAIL_HOOK = None
+    assert q.result.status_code == ErrorCode.SUCCESS  # degraded, not broken
+    assert q.knn_route == "device" and q.knn_demoted is not None
+    q2 = proxy.serve_query(Q_RANK_THEN_PATTERN, blind=False)
+    assert q2.knn_route == "host"  # the memo absorbed the demotion
+    assert np.array_equal(q2.result.table, q.result.table)
+
+
+def test_explain_renders_knn_estimate_line(tri_world):
+    g, ss, proxy = _hybrid_world(tri_world)
+    Global.enable_vectors = True
+    r = proxy.explain_query(Q_RANK_THEN_PATTERN)
+    assert r["knn"]["mode"] == "rank_then_pattern"
+    assert r["knn"]["k"] == 6
+    assert r["knn"]["est_rows"] == g.vstore.live_count()
+    assert r["knn"]["est_bytes"] == g.vstore.live_count() * DIM * 4
+    assert "knn:" in r["rendered"] and "est_rows=192" in r["rendered"]
+
+
+def test_result_cache_key_separates_knn_variants(tri_world):
+    """Two queries differing only in the knn clause (anchor / k) must
+    classify to different reuse keys; vector mutations are a declared
+    invalidation cause."""
+    from wukong_tpu.obs.reuse import INVALIDATION_CAUSES, classify
+
+    g, ss, proxy = _hybrid_world(tri_world)
+    Global.enable_vectors = True
+    assert "vector" in INVALIDATION_CAUSES
+    qa = proxy._parse_text(Q_RANK_THEN_PATTERN)
+    qb = proxy._parse_text(Q_RANK_THEN_PATTERN.replace(", 6)", ", 7)"))
+    qc = proxy._parse_text(Q_RANK_THEN_PATTERN.replace(
+        "<urn:cyc:v:0>", "<urn:cyc:v:1>"))
+    keys = set()
+    for q in (qa, qb, qc):
+        key, reason = classify(q)
+        assert reason is None
+        keys.add(key)
+    assert len(keys) == 3
+
+
+# ---------------------------------------------------------------------------
+# the GraphRAG serving loop (Emulator.run_graphrag)
+# ---------------------------------------------------------------------------
+
+def test_run_graphrag_mixed_loop_serves_both_kinds(tri_world):
+    from wukong_tpu.runtime.emulator import Emulator
+
+    g, ss, proxy = _hybrid_world(tri_world)
+    Global.enable_vectors = True
+    graph_texts = ["SELECT ?a ?b WHERE { ?a <urn:cyc:p:p1> ?b }"]
+    tmpl = "SELECT ?a ?b WHERE { knn(?a, {anchor}, 4) . " \
+           "?a <urn:cyc:p:p1> ?b }"
+    anchors = [f"<urn:cyc:v:{i}>" for i in range(8)]
+    out = Emulator(proxy).run_graphrag(
+        graph_texts, tmpl, anchors, duration_s=0.4, warmup_s=0.1,
+        clients=2, seed=7)
+    assert out["errors"] == 0
+    assert out["hybrid"]["served"] > 0 and out["graph"]["served"] > 0

@@ -198,6 +198,7 @@ def _load_builtin_plugins() -> None:
         placegate,
         slogate,
         telemetry,
+        vectorgate,
     )
 
 

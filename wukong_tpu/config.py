@@ -466,6 +466,32 @@ class GlobalConfig:
     # over the federated view).
     join_dist_parts: int = 4
 
+    # ---- hybrid graph+vector knobs (wukong_tpu/vector/; runtime-mutable) ----
+    # master switch for the vector subsystem: off keeps the serving path
+    # byte-identical (one knob check per knn-free query — the
+    # enable_result_cache / enable_admission actuator posture). A query
+    # carrying a knn() clause while this is off is refused, never
+    # silently degraded.
+    enable_vectors: bool = False
+    # fixed embedding width of every attached vector store; upserts with
+    # any other width are refused (the [n_slots, dim] block layout is
+    # shape-stable so the jitted scan compiles one variant per store)
+    vector_dim: int = 64
+    # k-NN similarity behind the one kernel seam: cosine | dot | l2
+    # (l2 ranks by NEGATIVE squared distance so "higher score = nearer"
+    # holds across all three metrics)
+    knn_metric: str = "cosine"
+    # k-NN scan route: host (NumPy brute force), device (force the jitted
+    # XLA batched-matmul scan), auto (device when the candidate volume
+    # amortizes the dispatch — knn_split_threshold — with measured
+    # demotion back to host on device failure, the join_device posture)
+    knn_device: str = "auto"
+    # wide-scan threshold (live vectors): at or past it a full-store scan
+    # classifies down the heavy lane and splits into slice ranges across
+    # the engine pool (join/dist.py gather-barrier shape); under
+    # knn_device=auto it is also the device-dispatch amortization floor
+    knn_split_threshold: int = 65536
+
     # ---- TPU-engine knobs (new; no reference analogue) ----
     table_capacity_min: int = 1024  # smallest binding-table capacity class
     # largest capacity class: 32M rows x 8 cols x int32 = 1 GiB, within one

@@ -120,6 +120,8 @@ class CPUEngine:
                 if from_proxy:
                     self._final_process(q)
                 return q
+            if getattr(q, "knn", None) is not None:
+                self._knn_pre(q)
             if q.has_pattern and not q.done_patterns():
                 self._execute_patterns(q)
             if q.pattern_group.unions and not q.union_done:
@@ -129,6 +131,8 @@ class CPUEngine:
                     self._execute_optional(q)
             if q.pattern_group.filters:
                 self._execute_filters(q)
+            if getattr(q, "knn", None) is not None:
+                self._knn_post(q)
             if from_proxy:
                 self._final_process(q)
         except (QueryTimeout, BudgetExceeded) as e:
@@ -162,6 +166,87 @@ class CPUEngine:
         q.pattern_step = len(q.pattern_group.patterns)
         q.union_done = True
         q.optional_step = len(q.pattern_group.optional)
+
+    # ------------------------------------------------------------------
+    # hybrid graph+vector composition (wukong_tpu/vector/)
+    # ------------------------------------------------------------------
+    def _vstore(self):
+        vs = getattr(self.g, "vstore", None)
+        if vs is None:
+            raise WukongError(ErrorCode.ATTR_DISABLE,
+                              "knn() needs a vector store attached to this "
+                              "partition (loader --vectors / upsert_batch_into)")
+        return vs
+
+    def _knn_params(self, q):
+        from wukong_tpu.config import Global
+        from wukong_tpu.vector import knn as vknn
+
+        vs = self._vstore()
+        anchor = vknn.resolve_anchor(vs, q.knn)
+        metric = q.knn.metric or Global.knn_metric
+        # the proxy stamps the measured route at plan time; direct engine
+        # callers default to the host kernels (always available)
+        route = getattr(q, "knn_route", None) or "host"
+        return vs, anchor, metric, route
+
+    def _knn_pre(self, q: SPARQLQuery) -> None:
+        """Seed-side composition: for a pure scan or a rank-then-pattern
+        chain, run the ranked scan first and seed the binding table with
+        the top-k vids (the corun sub-query seeding idiom) so the BGP
+        walks outward from the k winners. Pattern-then-rank defers to
+        :meth:`_knn_post`."""
+        from wukong_tpu.config import Global
+        from wukong_tpu.vector import knn as vknn
+
+        if not Global.enable_vectors:
+            raise WukongError(ErrorCode.ATTR_DISABLE,
+                              "knn() requires enable_vectors")
+        if getattr(q, "knn_mode", None) is None:
+            q.knn_mode = vknn.classify_knn_mode(q)
+        if q.knn_mode == "pattern_then_rank":
+            return
+        seeds = getattr(q, "knn_seeds", None)
+        if seeds is None:
+            # not pre-solved by the proxy's wide-scan slice split: scan here
+            vs, anchor, metric, route = self._knn_params(q)
+            seeds, _scores, demoted = vknn.scan_topk(
+                vs, anchor, q.knn.k, metric, route=route)
+            if demoted:
+                q.knn_demoted = demoted
+        res = q.result
+        res.set_table(np.asarray(seeds, dtype=np.int64).reshape(-1, 1))
+        res.col_num = 1
+        res.add_var2col(q.knn.var, 0)
+
+    def _knn_post(self, q: SPARQLQuery) -> None:
+        """Rank-side composition (pattern-then-rank): rank the BGP's
+        binding set for the knn variable, keep only rows whose binding
+        made the top-k, and order surviving rows by rank (ties by
+        original row order, stable). Runs after FILTER so ranked rows
+        are exactly the rows a pure BGP would have served."""
+        from wukong_tpu.vector import knn as vknn
+
+        if getattr(q, "knn_mode", None) != "pattern_then_rank":
+            return
+        res = q.result
+        col = res.var2col(q.knn.var)
+        assert_ec(col != NO_RESULT, ErrorCode.NO_REQUIRED_VAR,
+                  "knn() variable is not bound by the pattern group")
+        vs, anchor, metric, route = self._knn_params(q)
+        top, _scores, demoted = vknn.rank_candidates(
+            vs, res.table[:, col], anchor, q.knn.k, metric, route=route)
+        if demoted:
+            q.knn_demoted = demoted
+        rank = {int(v): i for i, v in enumerate(top)}
+        vals = res.table[:, col]
+        pos = np.asarray([rank.get(int(v), -1) for v in vals],
+                         dtype=np.int64)
+        idx = np.nonzero(pos >= 0)[0]
+        order = idx[np.argsort(pos[idx], kind="stable")]
+        res.set_table(res.table[order])
+        if res.attr_table.size:
+            res.attr_table = res.attr_table[order]
 
     def _execute_patterns(self, q: SPARQLQuery) -> None:
         from wukong_tpu.config import Global

@@ -126,6 +126,11 @@ class TPUEngine:
                 if from_proxy:
                     self.cpu._final_process(q)
                 return q
+            if getattr(q, "knn", None) is not None:
+                # the hybrid seed/rank stages are host work either way
+                # (vector/knn.py routes device scans itself), so the device
+                # chain borrows the CPU engine's composition seams
+                self.cpu._knn_pre(q)
             if q.has_pattern and not q.done_patterns():
                 self._run_pattern_chain(q)
             if q.pattern_group.unions and not q.union_done:
@@ -166,6 +171,8 @@ class TPUEngine:
                         self.cpu._execute_optional(q)
             if q.pattern_group.filters:
                 self.cpu._execute_filters(q)
+            if getattr(q, "knn", None) is not None:
+                self.cpu._knn_post(q)
             if from_proxy:
                 self.cpu._final_process(q)
         except (QueryTimeout, BudgetExceeded) as e:

@@ -44,8 +44,10 @@ PLACEMENT_INPUTS = {
 }
 
 #: fetch outcome kinds a charge may carry (sharded_store._fetch_shard_impl;
-#: "rotation" = a migrated shard's read served by its demoted donor copy)
-FETCH_KINDS = ("primary", "failover", "degraded", "rotation")
+#: "rotation" = a migrated shard's read served by its demoted donor copy;
+#: "vector" = a k-NN embedding scan charged by vector/knn.py — the heat
+#: planner sees hybrid traffic the same way it sees graph fetches)
+FETCH_KINDS = ("primary", "failover", "degraded", "rotation", "vector")
 
 EWMA_ALPHA = 0.2
 

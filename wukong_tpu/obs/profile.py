@@ -243,6 +243,21 @@ def _build_report(q, est: list | None, trace: QueryTrace | None,
         dist = getattr(q, "join_dist", None)
         if dist:
             report["join_dist"] = dist
+    # hybrid graph+vector: the knn scan's planned shape (wukong_tpu/vector/)
+    # — est rows = live embeddings the brute-force scan reads, est bytes =
+    # their float32 block, route/mode as stamped by the proxy at plan time
+    knn = getattr(q, "knn", None)
+    if knn is not None:
+        live = int(getattr(q, "_knn_live", 0))
+        dim = int(getattr(q, "_knn_dim", 0))
+        report["knn"] = {
+            "var": int(knn.var), "k": int(knn.k),
+            "metric": knn.metric or "(knob default)",
+            "mode": getattr(q, "knn_mode", "") or knn.mode,
+            "route": getattr(q, "knn_route", "host"),
+            "est_rows": live,
+            "est_bytes": live * dim * 4,
+        }
     if est is not None:
         report["est_total_cost"] = round(est[-1]["est_cost_cum"], 1)
     if trace is not None:
@@ -292,6 +307,12 @@ def _render(report: dict) -> str:
                  f"{report['optional']} optional group(s), planned "
                  "recursively — not estimated here)")
     lines.append(tail)
+    if report.get("knn"):
+        kn = report["knn"]
+        lines.append(
+            f"knn: var={kn['var']} k={kn['k']} metric={kn['metric']} "
+            f"mode={kn['mode']} route={kn['route']} "
+            f"est_rows={kn['est_rows']:,} est_bytes={kn['est_bytes']:,}")
     if report.get("route") is not None:
         # the level-route line: host NumPy kernels vs the XLA device path
         # (+ the distributed fan-out width when the join was sharded)

@@ -78,8 +78,10 @@ CACHE_INPUTS = {
 #: the store-mutation paths that must invalidate a version-keyed result
 #: cache (each has a maybe_note_invalidation call site — gate-enforced):
 #: dynamic insert batches, stream epochs, migration cutover, recovery
-#: restore
-INVALIDATION_CAUSES = ("insert", "epoch", "cutover", "restore")
+#: restore, vector upsert/tombstone batches (wukong_tpu/vector/vstore.py —
+#: embedding mutations bump the store version too, so cached knn replies
+#: never survive them)
+INVALIDATION_CAUSES = ("insert", "epoch", "cutover", "restore", "vector")
 
 #: why a reply could not have been served from a version-keyed result
 #: cache — mirroring PlanCache's uncacheable rules (shape/planner_empty/
@@ -197,9 +199,18 @@ def classify(q):
         # a duplicated abstracted constant is positionally ambiguous for
         # the plan recipe AND for const substitution in a cached result
         return None, "ambiguous_const"
+    # a knn() clause changes the reply without changing the pattern
+    # signature: the clause joins the key (anchor bytes for literal
+    # vectors), so a hybrid query never collides with its knn-free twin
+    # or with a different anchor/k/metric
+    knn = getattr(q, "knn", None)
     key = (_sig_digest(sig), tuple(consts),
            repr(pg.filters) if pg.filters else "",
            tuple(q.result.required_vars), bool(q.result.blind))
+    if knn is not None:
+        key = key + ((int(knn.var), int(knn.k), str(knn.metric),
+                      int(knn.anchor_vid) if knn.anchor_vid is not None
+                      else knn.anchor_vec.tobytes()),)
     return key, None
 
 

@@ -82,6 +82,9 @@ KNOWN_FAULT_SITES = frozenset({
                            # per-query)
     "proxy.serve",         # serving-boundary dispatch (runtime/proxy.py;
                            # the SLO-plane chaos scenario's injection point)
+    "vector.upsert",       # embedding upsert batch (vector/vstore.py;
+                           # fires BEFORE the WAL append, so an injected
+                           # failure leaves WAL and vstore both untouched)
     "migration.clone",     # shard-migration snapshot (runtime/migration.py)
     "migration.catchup",   # shard-migration WAL-tail replay + dual-write
     "migration.cutover",   # shard-migration read-path swap

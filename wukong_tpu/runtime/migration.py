@@ -386,11 +386,19 @@ class MigrationExecutor:
                 # itself is suppressed (direct per-partition inserts fire
                 # no WAL hook anyway — the _rebuild_shard contract)
                 with wal.suppress():
+                    from wukong_tpu.vector.vstore import apply_vector_record
+
                     for rec in wal.replay(after_seq=job.seq_clone):
-                        insert_triples(
-                            job.recipient, rec.payload["triples"],
-                            dedup=bool(rec.payload.get("dedup", True)),
-                            check_ids=False)
+                        if rec.kind == "vector":
+                            # embedding mutations ride the same tail: the
+                            # recipient's vstore must match the donor's at
+                            # sink-enroll time or knn answers tear on cutover
+                            apply_vector_record(job.recipient, rec.payload)
+                        else:
+                            insert_triples(
+                                job.recipient, rec.payload["triples"],
+                                dedup=bool(rec.payload.get("dedup", True)),
+                                check_ids=False)
                         replayed += 1
                 enroll_migration_sink(_sink_key(donor), job.recipient)
                 job.dirty_catchup = False

@@ -106,6 +106,18 @@ class Proxy:
         self._m_route_demoted = self.metrics.counter(
             "wukong_join_route_demotions_total",
             "Templates demoted device->host by measured-candidate feedback")
+        # hybrid graph+vector serving (wukong_tpu/vector/): per-mode knn
+        # query counts, plan-time scan-route decisions, and the measured
+        # demotions back to the host kernels (the JOIN_ROUTES posture)
+        self._m_vec_queries = self.metrics.counter(
+            "wukong_vector_queries_total",
+            "knn() queries by composition mode", labels=("mode",))
+        self._m_vec_route = self.metrics.counter(
+            "wukong_vector_route_total",
+            "Plan-time knn scan route decisions", labels=("route",))
+        self._m_vec_demoted = self.metrics.counter(
+            "wukong_vector_route_demotions_total",
+            "knn templates demoted device->host by measured feedback")
         self._wcoj = None  # guarded by: _batcher_init_lock
         self._wcoj_dist = None  # guarded by: _batcher_init_lock
         self._pool = None
@@ -231,6 +243,13 @@ class Proxy:
                 raise WukongError(ErrorCode.UNKNOWN_PLAN, "bad plan file")
             else:
                 return
+        if getattr(getattr(q, "knn", None), "mode", "") == "rank_then_pattern":
+            # a seeded chain executes in TEXTUAL order outward from the
+            # knn seeds: a planner reorder would re-root the chain away
+            # from the seeded variable and flip the query's semantics
+            q._tsig = template_signature(q)
+            q._rver = self._plan_version()[0]
+            return
         # plan cache: same template signature + same store version replays
         # the recorded plan recipe (dynamic inserts / stream commits bump
         # the version, so stale plans never apply)
@@ -514,6 +533,8 @@ class Proxy:
         # (query_deadline_ms / query_budget_rows; None when both off)
         qq.deadline = Deadline.from_config()
         self._plan(qq, plan_text)
+        if getattr(qq, "knn", None) is not None:
+            self._prepare_knn(qq)
         qq.lane = self.classify_lane(qq)
         self._m_lane.labels(lane=qq.lane).inc()
         qq.join_strategy = self.classify_join_strategy(qq)
@@ -521,6 +542,119 @@ class Proxy:
         if qq.join_strategy == "wcoj":
             qq.join_route = self.classify_join_route(qq)
             self._m_join_route.labels(route=qq.join_route).inc()
+
+    # ------------------------------------------------------------------
+    # hybrid graph+vector routing (wukong_tpu/vector/)
+    # ------------------------------------------------------------------
+    def _prepare_knn(self, q: SPARQLQuery) -> None:
+        """Plan-time knn stamps: refuse when the subsystem is off (the
+        actuator posture — never silently degrade a vector query to a
+        graph query), classify the composition mode and scan route, and
+        flag wide scans so lane routing sends them down the heavy lane."""
+        from wukong_tpu.vector import knn as vknn
+
+        if not Global.enable_vectors:
+            raise WukongError(ErrorCode.ATTR_DISABLE,
+                              "knn() requires enable_vectors")
+        q.knn_mode = vknn.classify_knn_mode(q)
+        self._m_vec_queries.labels(mode=q.knn_mode).inc()
+        vs = getattr(self.g, "vstore", None)
+        n = int(vs.live_count()) if vs is not None else 0
+        # EXPLAIN inputs (obs/profile.py): scan size = every live
+        # embedding, scan bytes = the float32 block the kernel reads
+        q._knn_live = n
+        q._knn_dim = int(vs.dim) if vs is not None else 0
+        # a wide scan-side composition (pure scan / rank-then-pattern)
+        # is heavy-lane work: slice-range split across the engine pool
+        q._knn_wide = (q.knn_mode != "pattern_then_rank"
+                       and n >= max(int(Global.knn_split_threshold), 1))
+        q.knn_route = self.classify_knn_route(q, n)
+        self._m_vec_route.labels(route=q.knn_route).inc()
+
+    def classify_knn_route(self, q: SPARQLQuery, live: int) -> str:
+        """Plan-time host/device route for the knn scan, memoized per
+        template signature + store version under ``knn_device auto``
+        (vector upserts bump the store version, so the volume-driven
+        decision re-arms on every embedding mutation). Overwritten by
+        ``_record_knn_feedback`` when the device path failed."""
+        knob = str(Global.knn_device).strip().lower()
+        if knob in ("host", "device"):
+            return knob
+        thr = max(int(Global.knn_split_threshold), 1)
+
+        def compute() -> str:
+            # device when the scan volume amortizes the dispatch: the
+            # split threshold doubles as the auto-device floor (both mark
+            # "wide enough that per-dispatch overhead stops mattering")
+            return "device" if live >= thr else "host"
+
+        sig = template_signature(q)
+        if sig is None:
+            return compute()  # pure scans: unmemoized, computed per query
+        return self._plan_cache.aux("knn_route", sig,
+                                    self._knn_route_memo_key(), compute)
+
+    def _knn_route_memo_key(self):
+        return (*self._plan_version(), "auto",
+                int(Global.knn_split_threshold))
+
+    def _record_knn_feedback(self, q: SPARQLQuery) -> None:
+        """Measured-feedback demotion for the knn device route: the
+        engine/slice fallback latched a device failure onto the query
+        (``knn_demoted``) — under ``knn_device auto``, demote the
+        template's memoized route to host so same-template queries stop
+        re-paying the failed device attempt. A store mutation or knob
+        flip re-arms the volume-driven decision."""
+        if getattr(q, "knn", None) is None:
+            return
+        demoted = getattr(q, "knn_demoted", None)
+        if demoted is None:
+            return
+        if str(Global.knn_device).strip().lower() == "auto":
+            sig = template_signature(q)
+            if sig is not None:
+                self._plan_cache.put_aux("knn_route", sig,
+                                         self._knn_route_memo_key(), "host")
+        self._m_vec_demoted.inc()
+        log_info(f"knn device route: demoted to host ({demoted})")
+
+    def _maybe_presolve_knn(self, q: SPARQLQuery) -> None:
+        """Wide scan-side knn: run the slice-range split across the
+        engine pool's heavy lane HERE (the proxy owns the pool), stamping
+        the ranked seeds onto the query so the engine's ``_knn_pre``
+        consumes them instead of scanning inline. Any fan-out failure
+        falls back to the engine's single-threaded scan — degraded, never
+        broken."""
+        if (getattr(q, "knn", None) is None
+                or not getattr(q, "_knn_wide", False)
+                or getattr(q, "knn_seeds", None) is not None):
+            return
+        vs = getattr(self.g, "vstore", None)
+        if vs is None:
+            return  # the engine raises the structured error
+        from wukong_tpu.vector import knn as vknn
+
+        try:
+            anchor = vknn.resolve_anchor(vs, q.knn)
+        except WukongError:
+            return  # the engine surfaces it with proper status plumbing
+        metric = q.knn.metric or Global.knn_metric
+        thr = max(int(Global.knn_split_threshold), 1)
+        n = int(vs.live_count())
+        parts = max(min(n // thr + 1, 8), 1)
+        if parts <= 1:
+            return
+        try:
+            seeds, _scores, demoted = vknn.sliced_topk(
+                self.engine_pool(), vs, anchor, q.knn.k, metric,
+                getattr(q, "knn_route", "host"), parts)
+        except Exception as e:
+            log_info(f"knn sliced scan failed ({type(e).__name__}); "
+                     "the engine scans inline")
+            return
+        q.knn_seeds = seeds
+        if demoted:
+            q.knn_demoted = demoted
 
     # ------------------------------------------------------------------
     # tensor-join strategy routing (wukong_tpu/join/)
@@ -533,7 +667,10 @@ class Proxy:
         immediately instead of serving stale decisions."""
         pg = q.pattern_group
         if (pg.unions or pg.optional or q.planner_empty
-                or not pg.patterns):
+                or not pg.patterns
+                or getattr(q, "knn", None) is not None):
+            # knn composition lives in the walk engine's pre/post hooks;
+            # the tensor-join executors have no vector seam
             return "walk"
         knob = str(Global.join_strategy).strip().lower()
         if knob == "walk":
@@ -707,6 +844,10 @@ class Proxy:
         ``heavy_rows_threshold``. Memoized per template signature + store
         version through the plan cache, so the estimate walk runs once per
         template, not per query."""
+        if getattr(q, "_knn_wide", False):
+            # a wide knn scan is index-origin-shaped work: a full-store
+            # pass, slice-range split across the pool (the PR 8 split)
+            return "heavy"
         try:
             if q.start_from_index():
                 return "heavy"
@@ -823,7 +964,10 @@ class Proxy:
                         tr.event("join.fallback", reason=reason)
                     log_info(f"wcoj degraded to the walk ({reason})")
             if Global.enable_batching and not pinned and eng is not None \
-                    and eng is not self.dist:
+                    and eng is not self.dist \
+                    and getattr(q, "knn", None) is None:
+                # knn queries bypass the coalescer: their scan dispatch
+                # is the batch (one fused matmul over the whole store)
                 pend = self.batcher().offer(q)
                 if pend is not None:
                     timeout = _batch_wait_timeout(q)
@@ -837,7 +981,10 @@ class Proxy:
                                   f"{timeout:.0f}s; batcher wedged?")
                         raise
                     return q
+            if getattr(q, "knn", None) is not None:
+                self._maybe_presolve_knn(q)
             eng.execute(q)  # batcher bypass: direct dispatch
+            self._record_knn_feedback(q)
             return q
         finally:
             if lease is not None:

@@ -289,7 +289,7 @@ class RecoveryManager:
 
         trace = maybe_start_trace(kind="recovery")
         stats = {"checkpoint": None, "restored_parts": 0,
-                 "replayed": {"insert": 0, "epoch": 0},
+                 "replayed": {"insert": 0, "epoch": 0, "vector": 0},
                  "epoch": 0, "standing_queries": 0}
         with activate(trace):
             self._recover_impl(stats, trace)
@@ -429,6 +429,15 @@ class RecoveryManager:
                     self.stream.ingestor.epoch = ep - 1
                     self.stream.ingestor.commit_epoch(
                         rec.payload["triples"], ts=rec.payload.get("ts"))
+                elif rec.kind == "vector":
+                    # embedding mutation: re-apply into every target's
+                    # vstore (attaches one if the checkpoint predates the
+                    # vector plane); version numbering re-derives, same as
+                    # graph versions do
+                    from wukong_tpu.vector.vstore import apply_vector_record
+
+                    for g in self._mutation_targets():
+                        apply_vector_record(g, rec.payload)
                 else:
                     # plain insert — or an epoch with no stream context to
                     # re-evaluate it: the data still must not be lost
@@ -436,7 +445,8 @@ class RecoveryManager:
                         insert_triples(g, rec.payload["triples"],
                                        dedup=rec.payload["dedup"],
                                        check_ids=False)
-                kind = "epoch" if rec.kind == "epoch" else "insert"
+                kind = rec.kind if rec.kind in ("epoch", "vector") \
+                    else "insert"
                 stats["replayed"][kind] += 1
                 _M_REPLAYED.labels(kind=kind).inc()
         if sp is not None:
@@ -542,10 +552,15 @@ class RecoveryManager:
             # suppress() — holding the process-wide suppression on this
             # background thread would let concurrent LIVE commits skip
             # their WAL appends (acknowledged-but-unlogged writes)
+            from wukong_tpu.vector.vstore import apply_vector_record
+
             for rec in wal.replay(after_seq=int(man["wal_seq"])):
-                insert_triples(g_new, rec.payload["triples"],
-                               dedup=rec.payload["dedup"],
-                               check_ids=False)
+                if rec.kind == "vector":
+                    apply_vector_record(g_new, rec.payload)
+                else:
+                    insert_triples(g_new, rec.payload["triples"],
+                                   dedup=rec.payload["dedup"],
+                                   check_ids=False)
         ss.rebuild_shard(i, store=g_new, source="checkpoint")
         log_info(f"shard {i} rebuilt from {path} + WAL tail and promoted")
         emit_event("shard.heal", shard=int(i), source="checkpoint")

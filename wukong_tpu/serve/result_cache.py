@@ -73,6 +73,9 @@ MUTATION_EDGES = {
                "counters are not comparable across the publication)",
     "restore": "conservative full purge (checkpointed world: restored "
                "versions are not comparable to the cached keys')",
+    "vector": "drop stale-version entries (embedding mutations carry no "
+              "triples, so no view can prove a template untouched — every "
+              "key re-keys at the bumped version or dies)",
 }
 
 #: ceiling on a follower's wait for its leader's settlement (a wedged

@@ -34,7 +34,10 @@ from wukong_tpu.utils.errors import CheckpointCorrupt
 from wukong_tpu.utils.logger import log_warn
 
 FORMAT_NAME = "wukong-gstore"
-FORMAT_VERSION = (2, 0)  # (major, minor): newer-major bundles are refused
+FORMAT_VERSION = (2, 1)  # (major, minor): newer-major bundles are refused
+# 2.1: optional vector-store arrays (vstore_*) + "vstore" meta entry —
+# a minor bump, so 2.0 readers of this lineage would still load the
+# graph arrays and 2.0 bundles load here (no vstore attached)
 
 
 def _crc(arr: np.ndarray) -> int:
@@ -72,6 +75,13 @@ def _collect_arrays(g: GStore) -> tuple[dict, dict]:
     arrays["v_set"] = g.v_set
     arrays["t_set"] = g.t_set
     arrays["p_set"] = g.p_set
+    vs = getattr(g, "vstore", None)
+    if vs is not None:
+        # the embedding plane rides the same bundle (same checksums,
+        # same digest surface): a checkpoint/restore that carried the
+        # triples but dropped the vectors would silently break knn
+        meta["vstore"] = {"dim": int(vs.dim), "version": int(vs.version)}
+        arrays.update(vs.export_arrays())
     return meta, arrays
 
 
@@ -163,6 +173,13 @@ def load_gstore(path: str) -> GStore:
         g.v_set = a["v_set"]
         g.t_set = a["t_set"]
         g.p_set = a["p_set"]
+        vmeta = meta.get("vstore")
+        if vmeta is not None:
+            from wukong_tpu.vector.vstore import VectorStore
+
+            g.vstore = VectorStore.from_arrays(
+                g.sid, g.num_workers, a["vstore_vids"], a["vstore_vecs"],
+                a["vstore_alive"], version=int(vmeta.get("version", 0)))
     except (KeyError, TypeError) as e:
         raise CheckpointCorrupt(f"malformed manifest: {e}",
                                 path=path) from None
@@ -194,6 +211,8 @@ def clone_gstore(g: GStore) -> GStore:
     g2.attrs = dict(g.attrs)
     g2.type_ids = set(g.type_ids)
     g2.version = getattr(g, "version", 0)
+    if getattr(g, "vstore", None) is not None:
+        g2.vstore = g.vstore.clone()  # shares the immutable slot arrays
     return g2
 
 
@@ -210,6 +229,9 @@ def adopt_gstore(g: GStore, g2: GStore) -> None:
     g.v_set, g.t_set, g.p_set = g2.v_set, g2.t_set, g2.p_set
     g.attrs = g2.attrs
     g.type_ids = g2.type_ids
+    # the embedding plane swaps with the graph (an adopted world without
+    # a vstore must also DROP any stale one the target carried)
+    g.vstore = getattr(g2, "vstore", None)
     g.version = max(getattr(g, "version", 0), g2.version) + 1
 
 

@@ -301,6 +301,20 @@ class StreamIngestor:
                               retry_on=(TransientFault, OSError))
         return _ingest()
 
+    def commit_vector_epoch(self, vids, vecs=None,
+                            tombstone: bool = False) -> int:
+        """Vector-plane sibling of commit_epoch: apply one embedding
+        upsert (or tombstone) batch to the same store fan-out this
+        ingestor commits triple epochs into. WAL-before-ack, migration
+        dual-write sinks, version bumps, and serving invalidation all
+        live in upsert_batch_into — this seam just keeps stream-fed
+        embeddings and stream-fed triples on one target list (the
+        recovery heals that rebind ``stores`` cover both planes)."""
+        from wukong_tpu.vector.vstore import upsert_batch_into
+
+        return upsert_batch_into(self.stores, vids, vecs,
+                                 dedup=self.dedup, tombstone=tombstone)
+
     def ingest(self, source, max_epochs: int | None = None) -> list[EpochRecord]:
         """Drain a TripleSource (or any (ts, batch) iterable) into epochs."""
         out = []

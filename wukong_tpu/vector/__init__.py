@@ -1,0 +1,44 @@
+"""Hybrid graph+vector subsystem (ROADMAP item 5).
+
+Dense per-vertex embeddings as a first-class store plane plus a batched
+k-NN operator that composes with BGPs in both directions — the
+GraphRAG-shaped workload class ("nearest neighbors of ?x that also
+satisfy this graph pattern"), served through every existing plane
+instead of bolted on the side:
+
+- :mod:`wukong_tpu.vector.vstore` — the per-partition embedding store:
+  ``[n_slots, dim]`` float32 blocks keyed by vertex id with tombstoned
+  upserts, riding the WAL (``maybe_wal_append("vector", ...)`` before
+  ack), the checkpoint bundles (persist.py carries the arrays,
+  CRC'd and format-versioned), migration dual-write sinks, and the
+  store-version protocol (every vector mutation bumps the owning
+  partition's version, so plan/result/join-table caches invalidate
+  exactly like they do for triples).
+- :mod:`wukong_tpu.vector.knn` — the k-NN operator: one scoring seam
+  (cosine / dot / L2) written against a swappable array module, run as
+  plain NumPy on the host or as a jitted XLA batched-matmul + top-k
+  scan on the device (``join/kernels.py`` posture), with slice-range
+  splitting across the engine pool for wide scans (``join/dist.py``
+  gather-barrier shape).
+
+Everything is behind ``enable_vectors`` (default OFF — the actuator
+posture: one knob check per knn-free query, serving path otherwise
+byte-identical).
+"""
+
+from __future__ import annotations
+
+#: every signal the vector plane emits, mapped to the registered metric
+#: that backs it (the CACHE_INPUTS posture). The vector-coherence
+#: analysis gate verifies each named metric is actually registered
+#: somewhere in code and that this literal and the registrations never
+#: drift apart.
+VECTOR_METRICS = {
+    "upserts": "wukong_vector_upserts_total",
+    "tombstones": "wukong_vector_tombstones_total",
+    "queries": "wukong_vector_queries_total",
+    "routes": "wukong_vector_route_total",
+    "route_demotions": "wukong_vector_route_demotions_total",
+    "scan_latency": "wukong_vector_scan_us",
+    "scan_slices": "wukong_vector_scan_slices_total",
+}
