@@ -622,6 +622,25 @@ def serve_main(device_ok: bool) -> None:
         Global.enable_admission = prev_adm
         get_admission().reset()
 
+    # device-observatory overhead guard, same shape: when off the seams
+    # are one knob check each; when on the charge is post-sync dict
+    # updates under leaf locks — neither may shift the micro's band
+    from wukong_tpu.obs.device import get_device_obs
+
+    dlat = {"off": [], "on": []}
+    prev_dev = Global.enable_device_obs
+    get_device_obs().reset()
+    try:
+        for _round in range(30):
+            for mode in ("off", "on"):
+                Global.enable_device_obs = mode == "on"
+                for _ in range(10):
+                    t0 = get_usec()
+                    proxy.serve_query(two_hop, blind=True)
+                    dlat[mode].append(get_usec() - t0)
+    finally:
+        Global.enable_device_obs = prev_dev
+
     def band(xs: list) -> dict:
         xs = sorted(xs)
         return {"p25_us": int(xs[len(xs) // 4]),
@@ -636,6 +655,15 @@ def serve_main(device_ok: bool) -> None:
         "samples_per_mode": len(lat["off"]),
         "off": b_off, "on": b_on,
         "bands_overlap": bands_overlap,
+    }
+    db_off, db_on = band(dlat["off"]), band(dlat["on"])
+    device_bands_overlap = (db_off["p25_us"] <= db_on["p75_us"]
+                            and db_on["p25_us"] <= db_off["p75_us"])
+    device_observatory = {
+        "query": "2-hop chain micro, single-threaded, interleaved",
+        "samples_per_mode": len(dlat["off"]),
+        "off": db_off, "on": db_on,
+        "bands_overlap": device_bands_overlap,
     }
     _emit_final({
         "metric": f"LUBM-{scale} serving-path throughput, {clients} clients "
@@ -655,16 +683,24 @@ def serve_main(device_ok: bool) -> None:
             "mean_batch_occupancy": mean_occ,
             "batch_metrics": batch_metrics,
             "admission_overhead": admission_overhead,
+            "device_observatory": device_observatory,
             "dataset": DATASET_NOTES["lubm"],
         },
     }, "BENCH_SERVE.json")
-    # overhead guard self-gates (WUKONG_SERVE_NOGATE=1 skips for noisy
+    # overhead guards self-gate (WUKONG_SERVE_NOGATE=1 skips for noisy
     # local runs): an idle admission plane may not shift the micro's band
     if os.environ.get("WUKONG_SERVE_NOGATE") != "1" and not bands_overlap:
         raise SystemExit(
             f"serve drill FAILED: admission on/off p50 bands disjoint on "
             f"the 2-hop micro (off={b_off}, on={b_on}) — the off knob "
             "must be zero-touch")
+    # ...and neither may the device observatory's dispatch seams
+    if os.environ.get("WUKONG_SERVE_NOGATE") != "1" \
+            and not device_bands_overlap:
+        raise SystemExit(
+            f"serve drill FAILED: device-observatory on/off p50 bands "
+            f"disjoint on the 2-hop micro (off={db_off}, on={db_on}) — "
+            "the dispatch seam may not tax the hot path")
 
 
 def graphrag_main(device_ok: bool) -> None:
@@ -2783,6 +2819,150 @@ def _one_query_main() -> None:
     print(json.dumps(_measure_one(qn, scale)))
 
 
+def devicecost_main(device_ok: bool) -> None:
+    """`bench.py --devicecost`: device-observatory cost accounting over
+    the cyclic device-route suite, run TWICE in-process. The first pass
+    pays every jit variant cold (compile included); the second reuses
+    them — the compile ledger must show the amortization (second-pass
+    cold count strictly below the first). Headline: whole-suite padding
+    efficiency (live rows / pad_pow2 padded capacity over every charged
+    dispatch), reported per capacity class in detail. Self-gates: the
+    route stayed device, efficiency recorded for every minted capacity
+    class, cold amortization, and the residency high-water within
+    `device_budget_mb`. Artifact: BENCH_DEVICE.json
+    (WUKONG_DEVICE_NOGATE=1 records without gating)."""
+    from wukong_tpu.config import Global
+    from wukong_tpu.join.wcoj import WCOJExecutor
+    from wukong_tpu.loader.datagen import (
+        generate_clique4,
+        generate_diamond,
+        generate_triangle,
+    )
+    from wukong_tpu.obs.device import get_device_obs, read_device_input
+    from wukong_tpu.planner.optimizer import Planner
+    from wukong_tpu.planner.stats import Stats
+    from wukong_tpu.sparql.ir import Pattern, SPARQLQuery
+    from wukong_tpu.store.gstore import build_partition
+    from wukong_tpu.types import OUT
+    from wukong_tpu.utils.timer import get_usec
+
+    m_tri = int(os.environ.get("WUKONG_DEVICECOST_M", "800"))
+    reps = int(os.environ.get("WUKONG_DEVICECOST_REPS", "2"))
+    Global.enable_device_obs = True
+    Global.join_device = "device"
+    Global.wcoj_min_rows = 1
+    Global.wcoj_ratio = 1
+
+    def mkq(spec):
+        q = SPARQLQuery()
+        q.pattern_group.patterns = [Pattern(s, p, OUT, o)
+                                    for (s, p, o) in spec["patterns"]]
+        q.result.nvars = len(spec["vars"])
+        q.result.required_vars = list(spec["vars"])
+        q.result.blind = True
+        return q
+
+    worlds = [
+        ("triangle", *generate_triangle(m=m_tri, noise=8, seed=0)),
+        ("diamond", *generate_diamond(m=300, noise=4, seed=0)),
+        ("clique4", *generate_clique4(n=800, fan=8, ncliques=30, seed=0)),
+    ]
+    suites = []
+    for name, triples, spec in worlds:
+        g = build_partition(triples, 0, 1)
+        stats = Stats.generate(triples)
+        suites.append((name, WCOJExecutor(g, stats=stats),
+                       Planner(stats), spec))
+
+    obs = get_device_obs()
+    obs.reset()
+    routes_device = True
+
+    def run_pass() -> float:
+        nonlocal routes_device
+        t0 = get_usec()
+        for name, ex, planner, spec in suites:
+            for _ in range(reps):
+                q = mkq(spec)
+                planner.generate_plan(q)
+                ex.execute(q)
+                assert q.result.status_code == 0, (name,
+                                                   q.result.status_code)
+                levels = getattr(q, "join_stats", []) or []
+                if not levels or any(lv.get("route") != "device"
+                                     for lv in levels):
+                    routes_device = False
+        return round((get_usec() - t0) / 1e3, 1)
+
+    pass1_ms = run_pass()
+    c1 = read_device_input("dispatches")
+    pass2_ms = run_pass()
+    c2 = read_device_input("dispatches")
+    pass1_cold, pass2_cold = c1["cold"], c2["cold"] - c1["cold"]
+
+    # padding efficiency per pad_pow2 capacity class, over both passes
+    per_class: dict = {}
+    for r in obs.dispatch_ledger.report(1_000_000):
+        if not r["padded_rows"]:
+            continue
+        a = per_class.setdefault(r["capacity"], [0, 0])
+        a[0] += r["live_rows"]
+        a[1] += r["padded_rows"]
+    padding_by_class = {str(c): round(lv / pad, 4)
+                        for c, (lv, pad) in sorted(per_class.items())}
+    eff = read_device_input("padding_efficiency")
+    res = obs.residency.stats()
+    high_water_mb = round(res["high_water_bytes"] / (1 << 20), 3)
+
+    _emit_final({
+        "metric": f"device observatory: padding efficiency over the "
+                  f"cyclic device-route suite run twice (triangle "
+                  f"m={m_tri} + diamond + clique4, reps={reps}; cold "
+                  "amortization + residency budget self-gated)",
+        "value": round(eff, 4) if eff is not None else None,
+        "unit": "ratio",
+        "padding_efficiency": round(eff, 4) if eff is not None else None,
+        "pass1_cold": pass1_cold,
+        "pass2_cold": pass2_cold,
+        "dispatches": c2["count"],
+        "residency_high_water_mb": high_water_mb,
+        "device_budget_mb": int(Global.device_budget_mb),
+        "backend": "tpu" if device_ok else "cpu",
+        "detail": {
+            "padding_efficiency_by_capacity": padding_by_class,
+            "pass1_ms": pass1_ms, "pass2_ms": pass2_ms,
+            "dispatch_counts": c2,
+            "variants": read_device_input("variants"),
+            "residency": res,
+            "ranked": obs.dispatch_ledger.report(20),
+            "routes_device": routes_device,
+            "knobs": {"device_budget_mb": int(Global.device_budget_mb),
+                      "device_variant_limit":
+                          int(Global.device_variant_limit),
+                      "reps": reps, "m_tri": m_tri},
+        },
+    }, "BENCH_DEVICE.json")
+    if os.environ.get("WUKONG_DEVICE_NOGATE") != "1":
+        if not routes_device:
+            raise SystemExit(
+                "devicecost drill FAILED: a level left the device route "
+                "— the observatory measured a degraded run")
+        if eff is None or not padding_by_class:
+            raise SystemExit(
+                "devicecost drill FAILED: no padding efficiency recorded "
+                "— the dispatch seam never charged a capacity class")
+        if pass2_cold >= pass1_cold:
+            raise SystemExit(
+                f"devicecost drill FAILED: second-pass cold dispatches "
+                f"({pass2_cold}) not strictly below the first "
+                f"({pass1_cold}) — jit variants are not being reused")
+        if res["high_water_bytes"] > res["budget_bytes"]:
+            raise SystemExit(
+                f"devicecost drill FAILED: residency high-water "
+                f"{high_water_mb} MiB exceeds device_budget_mb "
+                f"{Global.device_budget_mb}")
+
+
 def main():
     if "--one" in sys.argv:
         _one_query_main()
@@ -2839,6 +3019,9 @@ def main():
         return
     if "--cyclic" in sys.argv:
         cyclic_main(device_ok)
+        return
+    if "--devicecost" in sys.argv:
+        devicecost_main(device_ok)
         return
     if "--tenants" in sys.argv:
         tenants_main(device_ok)

@@ -111,6 +111,15 @@ def _headline(d: dict) -> dict | None:
     if isinstance(d.get("predicted_hit_rate"), (int, float)):
         return {"value": float(d["predicted_hit_rate"]), "unit": "ratio",
                 "metric": "predicted_hit_rate"}
+    # device-observatory drill: whole-suite live/padded ratio over the
+    # cyclic device route run twice (BENCH_DEVICE.json; unit "ratio" is
+    # direction-less — the drill self-gates on cold amortization and
+    # the residency budget, so it is trended but never threshold-checked
+    # here). Before the generic value branch so the series keeps the
+    # short name instead of the long metric sentence
+    if isinstance(d.get("padding_efficiency"), (int, float)):
+        return {"value": float(d["padding_efficiency"]), "unit": "ratio",
+                "metric": "padding_efficiency"}
     if isinstance(d.get("value"), (int, float)):
         return {"value": float(d["value"]), "unit": d.get("unit", ""),
                 "metric": str(d.get("metric", ""))[:160]}

@@ -38,6 +38,14 @@ echo "== cyclic device-route drill (WCOJ host/device/walk identity) =="
 # on at least one case (exits non-zero otherwise; see cyclic_main gates)
 JAX_PLATFORMS=cpu python bench.py --cyclic
 
+echo "== device-cost drill (padding efficiency + cold amortization) =="
+# the cyclic device-route suite run twice with the device observatory
+# on: padding efficiency recorded per capacity class, the second pass's
+# cold-dispatch count strictly below the first (jit variants reused),
+# and the residency high-water within device_budget_mb (exits non-zero
+# otherwise; see devicecost_main gates)
+JAX_PLATFORMS=cpu python bench.py --devicecost
+
 echo "== tenant admission drill (2x-capacity overload ladder) =="
 # the multi-tenant SLO scenario incl. the admission plane's overload
 # variant: clients doubled, quotas armed — the protected tenant must

@@ -50,7 +50,7 @@ def test_plugin_registry():
         "fault-sites", "config-readme", "metrics-readme", "error-taxonomy",
         "heat-telemetry", "join-strategy", "slo-telemetry",
         "placement-telemetry", "migration-safety", "cache-coherence",
-        "admission-contract", "vector-coherence"}
+        "admission-contract", "vector-coherence", "device-telemetry"}
 
 
 def test_unknown_plugin_rejected():
@@ -739,3 +739,88 @@ def test_vector_gate_skips_trees_without_vector_plane(tmp_path):
     tree = write_tree(tmp_path / "plain", {
         "store/gstore.py": "def build():\n    return 1\n"})
     assert run_analysis(tree, plugins=["vector-coherence"]) == []
+
+
+# ---------------------------------------------------------------------------
+# device-telemetry gate: the device observatory
+# ---------------------------------------------------------------------------
+
+def test_device_gate_fixtures(tmp_path):
+    """DEVICE_INPUTS must be registered (and vice versa for
+    wukong_device_* names), every jit-minting engine/join/vector module
+    charges the dispatch seam or justifies itself in the allowlist
+    (non-empty, non-stale), device locks are leaves, and the
+    observatory's shared state is annotated."""
+    from wukong_tpu.analysis import run_analysis
+
+    bad = write_tree(tmp_path / "bad", {
+        "obs/device.py": (
+            "DEVICE_INPUTS = {'dispatches': 'wukong_device_d_total',"
+            " 'phantom': 'wukong_device_ghost_total'}\n"
+            "DEVICE_DISPATCH_ALLOWLIST = {"
+            "'engine/kernels.py': '',"              # empty justification
+            "'engine/retired.py': 'charged at the chain seam'}\n"
+            "def reg(r):\n"
+            "    r.counter('wukong_device_d_total', 'h')\n"
+            "    r.counter('wukong_device_rogue_total', 'h')\n"
+            "class Ledger:\n"
+            "    def __init__(self):\n"
+            "        self.stats = {}\n"
+            "        self._lock = make_lock('device.dispatch')\n"),
+        # mints jax.jit, never charges the seam, not allowlisted
+        "join/probe.py": (
+            "import jax\n"
+            "def mint():\n"
+            "    return jax.jit(lambda x: x)\n"),
+        # allowlisted as 'retired' but actually charges the seam → stale
+        "engine/retired.py": (
+            "import jax\n"
+            "def run(fn):\n"
+            "    out = jax.jit(fn)(1)\n"
+            "    maybe_device_dispatch('engine.retired', live=1)\n"
+            "    return out\n")})
+    out = run_analysis(bad, plugins=["device-telemetry"])
+    msgs = "\n".join(str(v) for v in out)
+    assert "wukong_device_ghost_total" in msgs  # declared, unregistered
+    assert "wukong_device_rogue_total" in msgs  # registered, undeclared
+    assert "join/probe.py" in msgs              # uncharged jit site
+    assert "empty" in msgs and "engine/kernels.py" in msgs
+    assert "stale" in msgs and "engine/retired.py" in msgs
+    assert "device.dispatch" in msgs            # undeclared leaf lock
+    assert "Ledger.stats" in msgs               # unannotated shared state
+
+    good = write_tree(tmp_path / "good", {
+        "obs/device.py": (
+            "declare_leaf('device.dispatch')\n"
+            "DEVICE_INPUTS = {'dispatches': 'wukong_device_d_total'}\n"
+            "DEVICE_DISPATCH_ALLOWLIST = {"
+            "'engine/kernels.py': 'dispatched and charged in "
+            "engine/run.py at the sync point'}\n"
+            "def reg(r):\n"
+            "    r.counter('wukong_device_d_total', 'h')\n"
+            "class Ledger:\n"
+            "    def __init__(self):\n"
+            "        self.stats = {}  # guarded by: _lock\n"
+            "        self._lock = make_lock('device.dispatch')\n"),
+        # definition-only module, justified in the allowlist
+        "engine/kernels.py": (
+            "import jax\n"
+            "compact = jax.jit(lambda x: x)\n"),
+        # invoking module charges the seam itself
+        "engine/run.py": (
+            "import jax\n"
+            "def run(fn, x):\n"
+            "    out = jax.jit(fn)(x)\n"
+            "    maybe_device_dispatch('engine.run', live=1)\n"
+            "    return out\n")})
+    assert run_analysis(good, plugins=["device-telemetry"]) == []
+
+
+def test_device_gate_skips_trees_without_device_plane(tmp_path):
+    """Pre-observatory trees (and foreign packages) are not required to
+    grow a DEVICE_INPUTS registry."""
+    from wukong_tpu.analysis import run_analysis
+
+    tree = write_tree(tmp_path / "plain", {
+        "engine/tpu.py": "import jax\nf = jax.jit(lambda x: x)\n"})
+    assert run_analysis(tree, plugins=["device-telemetry"]) == []

@@ -190,6 +190,7 @@ def _load_builtin_plugins() -> None:
     from wukong_tpu.analysis import (  # noqa: F401
         admitgate,
         cachegate,
+        devicegate,
         drift,
         guarded,
         joingate,

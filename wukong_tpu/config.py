@@ -283,6 +283,38 @@ class GlobalConfig:
     # the serving micro — the ledger charge always runs)
     reuse_sample_every: int = 1
 
+    # ---- device-cost observatory (wukong_tpu/obs/device.py; all
+    # mutable) ----
+    # ROADMAP item 8's decision substrate: per-dispatch XLA cost
+    # accounting (wall time, live rows vs padded capacity, bytes moved),
+    # the compile ledger (cold/warm split, per-site shape variants), and
+    # the device-residency ledger (bytes per kind vs the budget).
+    # Default ON: the hot serving path carries no device dispatch, so
+    # the per-hook cost is one knob check (BENCH_SERVE.json
+    # detail.device_observatory); off degrades every seam to that check.
+    enable_device_obs: bool = True
+    # device-resident byte ceiling the residency ledger reports against
+    # (telemetry only — DeviceStore's own LRU budget keeps enforcing;
+    # default mirrors tpu_mem_cache_gb so HBM_BUDGET.md's numbers and
+    # the live gauge describe the same ceiling)
+    device_budget_mb: int = 4096
+    # variant-storm sentinel: a dispatch site minting MORE than this
+    # many distinct (template, capacity-class) jit variants inside one
+    # sentinel window journals a device.variant_storm ClusterEvent and
+    # force-dumps the trace ring — the pad_pow2 capacity-class
+    # discipline's regression tripwire
+    device_variant_limit: int = 32
+    # seconds between variant-storm trips per site (the attribution_
+    # cooldown_s posture: one journal + dump per storm, not per dispatch)
+    device_storm_cooldown_s: float = 60.0
+    # persistent XLA compile-cache directory (utils/compilecache.py);
+    # empty = the WUKONG_CACHE_DIR env form, then <repo>/.cache/xla
+    xla_cache_dir: str = ""
+    # XProf/Perfetto capture directory for obs/export.py
+    # maybe_device_trace; empty = the WUKONG_XPROF_DIR env form, then no
+    # tracing (EXPLAIN ANALYZE's device section points operators here)
+    xprof_dir: str = ""
+
     # ---- materialized-view serving plane (wukong_tpu/serve/; all
     # mutable) ----
     # the REAL version-keyed full-result cache in the proxy reply path

@@ -15,6 +15,7 @@ from dataclasses import dataclass
 
 import numpy as np
 
+from wukong_tpu.obs.device import maybe_device_resident
 from wukong_tpu.types import IN, OUT, PREDICATE_ID, TYPE_ID
 
 INT32_MAX = np.iinfo(np.int32).max
@@ -251,12 +252,21 @@ class DeviceStore:
         dynamic_gstore.hpp:37-102)."""
         v = getattr(self.g, "version", 0)
         if v != getattr(self, "_seen_version", 0):
+            seg_bytes = sum(s.nbytes for s in self._cache.values())
+            idx_bytes = max(self.bytes_used - seg_bytes, 0)
             self._cache.clear()
             self._index_cache.clear()
             self._lru.clear()
             self.bytes_used = 0
             self.__dict__.pop("_fcsr_memo", None)  # filtered-CSR host memo
             self._seen_version = v
+            # ONE residency edge per kind per store-version bump
+            if seg_bytes:
+                maybe_device_resident("invalidate", "segment", seg_bytes,
+                                      version=int(v))
+            if idx_bytes:
+                maybe_device_resident("invalidate", "index", idx_bytes,
+                                      version=int(v))
 
     def segment(self, pid: int, d: int) -> DeviceSegment | None:
         """Stage (pid, dir) segment; TYPE_ID IN resolves to the type index CSR."""
@@ -327,6 +337,7 @@ class DeviceStore:
         self._index_cache[key] = entry
         self._lru.append(key)
         self.bytes_used += dev.size * 4
+        maybe_device_resident("fill", "index", dev.size * 4)
         self._enforce_budget()
         return entry
 
@@ -546,6 +557,7 @@ class DeviceStore:
         self._cache[key] = seg
         self._lru.append(key)
         self.bytes_used += seg.nbytes
+        maybe_device_resident("fill", "segment", seg.nbytes)
         self._enforce_budget()
 
     def _enforce_budget(self) -> None:
@@ -560,10 +572,13 @@ class DeviceStore:
 
     def _evict(self, key) -> None:
         if key in self._cache:
-            self.bytes_used -= self._cache.pop(key).nbytes
+            nb = self._cache.pop(key).nbytes
+            self.bytes_used -= nb
+            maybe_device_resident("evict", "segment", nb)
         else:
             dev, _ = self._index_cache.pop(key)
             self.bytes_used -= dev.size * 4
+            maybe_device_resident("evict", "index", dev.size * 4)
         self._lru.remove(key)
 
     def _touch(self, key) -> None:

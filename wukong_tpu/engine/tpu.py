@@ -34,6 +34,8 @@ from wukong_tpu.config import Global
 from wukong_tpu.engine import tpu_kernels as K
 from wukong_tpu.engine.cpu import CPUEngine
 from wukong_tpu.engine.device_store import DeviceStore
+from wukong_tpu.obs.device import maybe_device_dispatch
+from wukong_tpu.utils.timer import get_usec
 from wukong_tpu.sparql.ir import NO_RESULT, PGType, SPARQLQuery
 from wukong_tpu.types import IN, OUT, PREDICATE_ID, TYPE_ID, AttrType
 from wukong_tpu.utils.errors import (
@@ -281,9 +283,14 @@ class TPUEngine:
         for _attempt in range(8):
             self._last_attempts = _attempt + 1
             check_query(q, f"tpu.chain attempt {_attempt}")
+            t0 = get_usec()
             state = self._dispatch_chain(q, device_steps, cap_override,
                                          step_est)
             host_table, n, totals = state.sync(blind=blind_ok)
+            moved = 4 * (1 + len(totals))  # the ride-along scalars
+            if not blind_ok and hasattr(host_table, "nbytes"):
+                moved += int(host_table.nbytes)
+            _charge_chain(q, "tpu.chain", totals, get_usec() - t0, moved)
             over = [s for s, t, c in totals if t > c]
             if not over:
                 break
@@ -725,9 +732,16 @@ class TPUEngine:
                     anchor = state.col_of(pat.subject)
                     self._dispatch_one(q, pat, k, state, cap_override,
                                        anchor_col=anchor)
+                t0 = get_usec()
                 counts = _qid_counts(state.table, state.n, B)
                 payload = (counts, [t for (_, t, _) in state.totals])
                 host_counts, totals = jax.device_get(payload)
+                _charge_chain(
+                    q, "tpu.batch_chain",
+                    [(s, int(t), c)
+                     for (s, _, c), t in zip(state.totals, totals)],
+                    get_usec() - t0,
+                    4 * (B + len(totals)))
                 over = False
                 for (s, _, c), t in zip(state.totals, totals):
                     if int(t) > c:
@@ -980,6 +994,32 @@ class _ChainState:
             host_table = np.ascontiguousarray(np.asarray(host_table).T)
         return (host_table, int(n),
                 [(s, int(t), c) for (s, _, c), t in zip(self.totals, totals)])
+
+
+def _charge_chain(q: SPARQLQuery, site: str, totals: list,
+                  wall_us: int, moved: int) -> None:
+    """Charge one chain sync on the device observatory: one dispatch
+    record per fused step from the ride-along totals ``(step, total,
+    cap)``, with the attempt's dispatch-to-sync wall split evenly across
+    steps (the driver syncs ONCE per chain, so per-step device time is
+    not separately observable) and the D2H payload charged to the first
+    step. Records land on ``q.device_steps`` for EXPLAIN ANALYZE's
+    device table."""
+    if not totals or not Global.enable_device_obs:
+        return
+    per_us = int(wall_us) // len(totals)
+    for i, (s, t, c) in enumerate(totals):
+        rec = maybe_device_dispatch(
+            site, template=f"d{len(totals)}", live=min(int(t), int(c)),
+            capacity=int(c), wall_us=per_us,
+            nbytes=moved if i == 0 else 0)
+        if rec is None:
+            return
+        rec["step"] = int(s)
+        dev = getattr(q, "device_steps", None)
+        if dev is None:
+            dev = q.device_steps = []
+        dev.append(rec)
 
 
 _qid_counts_jit = None

@@ -34,9 +34,35 @@ import numpy as np
 
 from wukong_tpu.config import Global
 from wukong_tpu.engine import tpu_kernels as K
+from wukong_tpu.obs.device import maybe_device_dispatch
 from wukong_tpu.sparql.ir import SPARQLQuery
 from wukong_tpu.types import IN, OUT, PREDICATE_ID, TYPE_ID
 from wukong_tpu.utils.errors import ErrorCode, WukongError, assert_ec
+from wukong_tpu.utils.timer import get_usec
+
+
+def _charge_merge(site: str, totals, device_totals, wall_us: int,
+                  q=None) -> None:
+    """Charge one merge-chain sync on the device observatory from the
+    ride-along ``(step, _, cap)`` triples + their fetched device totals,
+    splitting the dispatch-to-sync wall evenly (ONE device_get covers
+    the whole chain). With ``q`` the records also land on
+    ``q.device_steps`` for EXPLAIN ANALYZE."""
+    if not totals or not Global.enable_device_obs:
+        return
+    per_us = int(wall_us) // len(totals)
+    for (s, _, c), t in zip(totals, device_totals):
+        rec = maybe_device_dispatch(
+            site, template=f"d{len(totals)}", live=min(int(t), int(c)),
+            capacity=int(c), wall_us=per_us)
+        if rec is None:
+            return
+        rec["step"] = int(s)
+        if q is not None:
+            dev = getattr(q, "device_steps", None)
+            if dev is None:
+                dev = q.device_steps = []
+            dev.append(rec)
 
 
 class _Level:
@@ -323,15 +349,19 @@ class MergeExecutor:
 
         eng = self.eng
         eng.dstore.pin(pin_set)
+        t0 = get_usec()
         try:
             flight = [t() for t in thunks]
             payload = [(c, [t for (_, t, _) in tot]) for c, tot in flight]
             host = jax.device_get(payload)
         finally:
             eng.dstore.unpin(pin_set)
+        wall = get_usec() - t0
         out = []
         for (slow, (host_counts, totals), (_, tot)) in zip(
                 slows, host, flight):
+            _charge_merge("tpu.merge.flight", tot, totals,
+                          wall // max(len(flight), 1))
             if any(int(t) > c for (_, _, c), t in zip(tot, totals)):
                 out.append(slow())
             else:
@@ -377,6 +407,7 @@ class MergeExecutor:
         eng.dstore.pin(pins)
         try:
             for _attempt in range(8):
+                t0 = get_usec()
                 state = _MergeState()
                 first = init(state)
                 assert first == (1 if mode != "const" else 0)
@@ -389,6 +420,8 @@ class MergeExecutor:
                                            slice_mode=slice_mode)
                 payload = (counts, [t for (_, t, _) in state.totals])
                 host_counts, totals = jax.device_get(payload)
+                _charge_merge("tpu.merge", state.totals, totals,
+                              get_usec() - t0, q=q)
                 over = False
                 for (s, _, c), t in zip(state.totals, totals):
                     exact = K.next_capacity(int(t), eng.cap_min, eng.cap_max)

@@ -52,6 +52,7 @@ from wukong_tpu.join.kernels import (
     to_device_i32,
 )
 from wukong_tpu.join.qgraph import U_CONST, U_PINDEX, U_TYPE, analyze
+from wukong_tpu.obs.device import maybe_device_dispatch, maybe_device_resident
 from wukong_tpu.obs.metrics import get_registry
 from wukong_tpu.obs.trace import traced_execute
 from wukong_tpu.runtime import faults
@@ -145,14 +146,44 @@ class JoinTableCache:
                 self._tables.move_to_end(key)
             return v
 
+    @staticmethod
+    def _dev_nbytes(key, value) -> int:
+        """Device-resident bytes of one cache entry (0 for host-side
+        segments/indexes — only ``dseg`` tuples live in HBM)."""
+        if key[1] != "dseg":
+            return 0
+        return sum(int(getattr(a, "nbytes", 0)) for a in value[:3])
+
     def _put(self, key, value):
+        evicted = []
+        stale = []
         with self._lock:
+            version = key[0]
+            if key[1] == "dseg":
+                # reap device tables a store-version bump orphaned: their
+                # keys can never hit again, but their HBM bytes would
+                # otherwise linger until LRU churn found them
+                stale = [k for k in self._tables
+                         if k[1] == "dseg" and k[0] != version]
+                stale_bytes = sum(self._dev_nbytes(k, self._tables.pop(k))
+                                  for k in stale)
             self._tables[key] = value
             self._tables.move_to_end(key)
             cap = max(int(Global.join_table_cache), 1)
             while len(self._tables) > cap:
-                self._tables.popitem(last=False)
-            return value
+                evicted.append(self._tables.popitem(last=False))
+        # residency charges OUTSIDE the cache lock (both are leaves)
+        if stale:
+            maybe_device_resident("invalidate", "join_table", stale_bytes,
+                                  version=int(version))
+        fill = self._dev_nbytes(key, value)
+        if fill:
+            maybe_device_resident("fill", "join_table", fill)
+        for k, v in evicted:
+            ev = self._dev_nbytes(k, v)
+            if ev:
+                maybe_device_resident("evict", "join_table", ev)
+        return value
 
     def segment(self, pid: int, d: int) -> CSRSegment:
         """The (pid, dir) adjacency as a verified-sorted CSR segment."""
@@ -211,7 +242,11 @@ class JoinTableCache:
 
     def clear(self) -> None:
         with self._lock:
+            dev = sum(self._dev_nbytes(k, v)
+                      for k, v in self._tables.items())
             self._tables.clear()
+        if dev:
+            maybe_device_resident("invalidate", "join_table", dev)
 
     def stats(self) -> dict:
         with self._lock:
@@ -481,7 +516,7 @@ class WCOJExecutor:
                              and getattr(q, "_join_device_broken", False)):
                 try:
                     mask = self._probe_device(G, adj, prefix, row_idx,
-                                              newcol, gid)
+                                              newcol, gid, q=q, level=k)
                     lvl_route = "device"
                 except Exception as e:
                     # degrade THIS query's remaining levels to host (the
@@ -509,7 +544,8 @@ class WCOJExecutor:
 
     # ------------------------------------------------------------------
     def _probe_device(self, G, adj, prefix: np.ndarray, row_idx: np.ndarray,
-                      newcol: np.ndarray, gid: np.ndarray) -> np.ndarray:
+                      newcol: np.ndarray, gid: np.ndarray, q=None,
+                      level: int = 0) -> np.ndarray:
         """The level's probe phase as one fused XLA dispatch per generator
         group: each group's padded flat candidate tensor is masked by
         every constraint EXCEPT its own generator (whose self-probe is
@@ -569,7 +605,24 @@ class WCOJExecutor:
                 args.extend([keys, offsets, edges, jnp.asarray(anchors)])
                 depths.append(depth)
             fn = jit_level_probe(tuple(depths), use_glob)
-            mask[lo:hi] = np.asarray(fn(*args))[:C]
+            t0 = get_usec()
+            mask[lo:hi] = np.asarray(fn(*args))[:C]  # blocking D2H sync
+            # candidate/anchor uploads + the mask back (device tables are
+            # cached residents and don't re-ship)
+            moved = Cp * (1 + 4 + 4 * len(adj_ids)) + C \
+                + (int(G.nbytes) if use_glob else 0)
+            rec = maybe_device_dispatch(
+                "wcoj.probe",
+                template="p" + "".join(map(str, depths))
+                + ("g" if use_glob else ""),
+                live=C, capacity=Cp, wall_us=get_usec() - t0,
+                nbytes=moved)
+            if rec is not None and q is not None:
+                rec["step"] = int(level)
+                dsteps = getattr(q, "device_steps", None)
+                if dsteps is None:
+                    dsteps = q.device_steps = []
+                dsteps.append(rec)
         return mask
 
     # ------------------------------------------------------------------

@@ -61,6 +61,10 @@ EVENT_KINDS = (
     # sheds and per-tenant quota breaches (correlate with -K admission —
     # shed storms, burn alerts, and breaker trips on one timeline)
     "admission.shed", "admission.quota",
+    # the device-cost observatory's variant-storm sentinel
+    # (obs/device.py): a dispatch site minted more than
+    # device_variant_limit jit variants inside one window
+    "device.variant_storm",
 )
 
 # the journal lock guards a deque append and the JSONL file handle —

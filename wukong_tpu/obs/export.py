@@ -8,8 +8,9 @@ on one track. ``write_chrome_trace`` wraps that in the JSON envelope.
 
 ``device_trace`` (absorbed from the retired runtime/tracing.py) scopes the
 JAX profiler around a block — the XProf/TensorBoard view of the device side
-of a traced query. ``maybe_device_trace`` gates it on ``WUKONG_XPROF_DIR``
-so the proxy/emulator wire it unconditionally at zero default cost.
+of a traced query. ``maybe_device_trace`` gates it on the ``xprof_dir``
+config knob (env form ``WUKONG_XPROF_DIR``) so the proxy/emulator wire it
+unconditionally at zero default cost.
 """
 
 from __future__ import annotations
@@ -32,9 +33,18 @@ def device_trace(logdir: str):
 
 
 def maybe_device_trace():
-    """``device_trace(WUKONG_XPROF_DIR)`` when the env var is set, else a
-    nullcontext — callers wrap hot paths unconditionally."""
-    logdir = os.environ.get("WUKONG_XPROF_DIR")
+    """``device_trace`` when a capture dir is configured — the
+    ``xprof_dir`` knob first, then the ``WUKONG_XPROF_DIR`` env form —
+    else a nullcontext, so callers wrap hot paths unconditionally and
+    EXPLAIN ANALYZE can point operators at a capture without env
+    plumbing."""
+    try:
+        from wukong_tpu.config import Global
+
+        logdir = str(Global.xprof_dir) or None
+    except Exception:
+        logdir = None
+    logdir = logdir or os.environ.get("WUKONG_XPROF_DIR")
     return device_trace(logdir) if logdir else contextlib.nullcontext()
 
 

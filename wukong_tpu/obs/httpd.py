@@ -94,6 +94,7 @@ class _MetricsHandler(BaseHTTPRequestHandler):
                       "/history", "/history.json", "/events",
                       "/events.json", "/plan", "/plan.json",
                       "/cache", "/cache.json",
+                      "/device", "/device.json",
                       "/admission", "/admission.json"):
             # top(1) for shards / templates / lanes (obs/profile.py), the
             # tenant SLO + overload-signal report (obs/slo.py), and the
@@ -126,6 +127,14 @@ class _MetricsHandler(BaseHTTPRequestHandler):
                 from wukong_tpu.obs.reuse import render_cache
 
                 text, js = render_cache(k)
+            elif path.startswith("/device"):
+                # the device-cost observatory: per-site dispatch +
+                # padding efficiency, jit variant counts, residency vs
+                # budget (obs/device.py — ROADMAP item 8's decision
+                # surface)
+                from wukong_tpu.obs.device import render_device
+
+                text, js = render_device(k)
             elif path.startswith("/history"):
                 from wukong_tpu.obs.tsdb import render_history
 
@@ -202,7 +211,7 @@ def maybe_start_metrics_http(port: int | None = None):
         _server = srv
         log_info(f"metrics http endpoint on :{srv.server_address[1]} "
                  "(/metrics, /metrics.json, /top, /slo, /history, "
-                 "/events, /plan, /cache, /admission, /healthz)")
+                 "/events, /plan, /cache, /device, /admission, /healthz)")
         return srv
 
 

@@ -31,6 +31,7 @@ templates (the attributor), and scheduler lanes.
 
 from __future__ import annotations
 
+import os
 from collections import deque
 
 from wukong_tpu.analysis.lockdep import declare_leaf, make_lock
@@ -258,6 +259,13 @@ def _build_report(q, est: list | None, trace: QueryTrace | None,
             "est_rows": live,
             "est_bytes": live * dim * 4,
         }
+    # device observatory: the per-step dispatch records the engine seams
+    # stamped onto the query (obs/device.py maybe_device_dispatch) — one
+    # row per fused chain step / wcoj device level, carrying padding
+    # efficiency and the cold/warm compile split
+    dev_steps = getattr(q, "device_steps", None)
+    if dev_steps:
+        report["device_steps"] = dev_steps
     if est is not None:
         report["est_total_cost"] = round(est[-1]["est_cost_cum"], 1)
     if trace is not None:
@@ -330,6 +338,30 @@ def _render(report: dict) -> str:
                          f"{lv['rows_out']:>9,} {lv['probes']:>6} "
                          f"{lv.get('route', 'host'):>7} "
                          f"{lv.get('time_us', 0):>9,}")
+    if report.get("device_steps"):
+        recs = report["device_steps"]
+        cold = sum(1 for r in recs if r.get("temp") == "cold")
+        live = sum(r.get("live", 0) for r in recs)
+        padded = sum(r.get("capacity", 0) * r.get("dispatches", 1)
+                     for r in recs)
+        eff = f"{live / padded:.1%}" if padded else "-"
+        lines.append(f"device: dispatches={len(recs)} cold={cold} "
+                     f"warm={len(recs) - cold} pad_eff={eff}")
+        lines.append(f"{'step':>4}  {'site':<16} {'template':<10} "
+                     f"{'capacity':>9} {'live':>9} {'eff':>6} "
+                     f"{'temp':>5} {'time_us':>9}")
+        for r in recs:
+            e = r.get("padding_efficiency")
+            lines.append(
+                f"{r.get('step', 0):>4}  {r['site']:<16.16} "
+                f"{r.get('template', ''):<10.10} "
+                f"{r.get('capacity', 0):>9,} {r.get('live', 0):>9,} "
+                f"{'-' if e is None else format(e, '.0%'):>6} "
+                f"{r.get('temp', '-'):>5} {r.get('wall_us', 0):>9,}")
+        xprof = str(Global.xprof_dir) or os.environ.get("WUKONG_XPROF_DIR")
+        if xprof:
+            lines.append(f"device trace: {xprof} (xprof_dir — XProf/"
+                         "Perfetto capture of these dispatches)")
     if analyze:
         lines.append(f"status: {report['status']} rows={report['rows']:,} "
                      f"complete={report['complete']} "

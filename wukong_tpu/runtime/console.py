@@ -62,6 +62,11 @@ cache [-k <n>] [-j]          serving plane + observatory: real result-
                              cache hit rate/bytes/views, shadow hit rate,
                              template popularity + cacheability verdicts,
                              invalidation trend (also GET /cache)
+device [-k <n>] [-j]         device-cost observatory: per-site XLA
+                             dispatch counts + padding efficiency,
+                             cold/warm compile split, jit variant counts,
+                             device-resident bytes vs budget
+                             (also GET /device)
 plan [-j] [-n]               observe-only placement advisor: run one
                              sweep and print the MigrationPlan + shard
                              lineage (-n skips the fresh sweep; also
@@ -139,6 +144,8 @@ class Console:
                 self._events(rest)
             elif cmd == "cache":
                 self._cache(rest)
+            elif cmd == "device":
+                self._device(rest)
             elif cmd == "plan":
                 self._plan_verb(rest)
             elif cmd == "migrate":
@@ -425,6 +432,17 @@ class Console:
         ap.add_argument("-j", action="store_true", help="JSON output")
         ns = ap.parse_args(rest)
         self._print_report(ns.j, *render_cache(ns.k))
+
+    def _device(self, rest) -> None:
+        """device: the device-cost observatory (the /device body)."""
+        from wukong_tpu.obs.device import render_device
+
+        ap = argparse.ArgumentParser(prog="device")
+        ap.add_argument("-k", type=int, default=None,
+                        help="dispatch rows shown (default: the top_k knob)")
+        ap.add_argument("-j", action="store_true", help="JSON output")
+        ns = ap.parse_args(rest)
+        self._print_report(ns.j, *render_device(ns.k))
 
     def _plan_verb(self, rest) -> None:
         """plan: one observe-only placement-advisor sweep + the last

@@ -174,6 +174,8 @@ class Monitor:
                 log_info(line)
             for line in self.cache_lines():
                 log_info(line)
+            for line in self.device_lines():
+                log_info(line)
             self._last_print = now
             self._last_cnt = self.cnt
 
@@ -431,6 +433,27 @@ class Monitor:
                      f"saved {sh['bytes_saved'] / 2**20:.1f} MiB"
                      f"{hot}]")
         return lines
+
+    def device_lines(self) -> list[str]:
+        """Rolling-report line for the device observatory: dispatch count
+        + cold/warm split + padding efficiency + resident bytes vs the
+        budget — quiet until any dispatch or residency fill has been
+        charged (host-only runs stay silent)."""
+        from wukong_tpu.obs.device import get_device_obs
+
+        obs = get_device_obs()
+        d = obs.dispatch_ledger.dispatch_counts()
+        res = obs.residency.stats()
+        if d["count"] == 0 and res["total_bytes"] == 0:
+            return []
+        eff = obs.dispatch_ledger.padding_efficiency()
+        return [f"Device[{d['count']:,} dispatches "
+                f"({d['cold']:,} cold / {d['warm']:,} warm), pad_eff "
+                + ("-" if eff is None else f"{eff:.1%}")
+                + f", resident {res['total_bytes'] / 2**20:.1f}"
+                f"/{res['budget_bytes'] / 2**20:.0f} MiB"
+                f" (hw {res['high_water_bytes'] / 2**20:.1f})"
+                + (", OVER BUDGET" if res["over_budget"] else "") + "]"]
 
     def heat_lines(self, k: int = 3) -> list[str]:
         """Rolling-report lines: the top-k hot shards, only when any fetch

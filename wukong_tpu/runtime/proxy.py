@@ -28,6 +28,7 @@ from wukong_tpu.obs import (
     maybe_start_metrics_http,
     maybe_start_trace,
 )
+from wukong_tpu.obs.device import note_feedback
 from wukong_tpu.obs.reuse import maybe_observe_reuse
 from wukong_tpu.obs.slo import get_overload, get_slo, tenant_label
 from wukong_tpu.runtime.admission import maybe_admission
@@ -616,6 +617,7 @@ class Proxy:
                 self._plan_cache.put_aux("knn_route", sig,
                                          self._knn_route_memo_key(), "host")
         self._m_vec_demoted.inc()
+        note_feedback("knn", "demote_host")
         log_info(f"knn device route: demoted to host ({demoted})")
 
     def _maybe_presolve_knn(self, q: SPARQLQuery) -> None:
@@ -644,6 +646,8 @@ class Proxy:
         parts = max(min(n // thr + 1, 8), 1)
         if parts <= 1:
             return
+        # the heavy-split decision: this scan fans out across the pool
+        note_feedback("knn", "heavy_split")
         try:
             seeds, _scores, demoted = vknn.sliced_topk(
                 self.engine_pool(), vs, anchor, q.knn.k, metric,
@@ -740,6 +744,7 @@ class Proxy:
             self._plan_cache.put_aux("route", sig, self._route_memo_key(),
                                      "host")
             self._m_route_demoted.inc()
+            note_feedback("join_route", "latched_host")
             log_info("wcoj device route: template demoted to host "
                      "(device path failed and latched host)")
             return
@@ -748,6 +753,7 @@ class Proxy:
             self._plan_cache.put_aux("route", sig, self._route_memo_key(),
                                      "host")
             self._m_route_demoted.inc()
+            note_feedback("join_route", "demote_host")
             log_info(f"wcoj device route: template demoted to host "
                      f"(measured candidates {measured:,} < "
                      f"join_device_min_candidates "
@@ -797,6 +803,7 @@ class Proxy:
         if measured > max(float(Global.wcoj_ratio), 1.0):
             self._plan_cache.put_aux("strategy", sig, key, "walk")
             self._m_join_demoted.inc()
+            note_feedback("strategy", "demote_walk")
             log_info(f"wcoj auto-routing: template demoted to the walk "
                      f"(measured prefix blowup {measured:.1f}x > "
                      f"wcoj_ratio {Global.wcoj_ratio} — wcoj did not keep "

@@ -176,11 +176,19 @@ def device_seed_masks(patterns: list, triples: np.ndarray, owner=None):
         o = np.full(npad, -1, dtype=np.int64)
         s[:n], p[:n], o[:n] = triples[:, 0], triples[:, 1], triples[:, 2]
         fn = jit_seed_masks()
+        t0 = get_usec()
         masks = np.asarray(fn(
             to_device_i32(s), to_device_i32(p), to_device_i32(o),
             to_device_i32(tp), to_device_i32(ts), to_device_i32(to),
-            np.asarray(eq)))[:, :n]
+            np.asarray(eq)))[:, :n]  # blocking D2H sync
         _M_SEED_BATCH.labels(outcome="device").inc()
+        from wukong_tpu.obs.device import maybe_device_dispatch
+
+        maybe_device_dispatch(
+            "stream.seed_masks", template=f"t{len(patterns)}",
+            live=n, capacity=npad, wall_us=get_usec() - t0,
+            nbytes=3 * 4 * npad + 3 * 4 * len(patterns)
+            + len(patterns) * (1 + npad))
         return masks
     except Exception as e:
         _M_SEED_BATCH.labels(outcome="fallback").inc()

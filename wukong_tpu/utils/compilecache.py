@@ -8,20 +8,51 @@ the second cold process compiles in 0.07 s vs 1.49 s fresh (21x). Console,
 bench, and the driver dryrun all call `setup_persistent_cache` before their
 first trace so cold starts are deployment-plausible (round-4 verdict
 Weak #3).
+
+Directory resolution order: explicit argument > the ``xla_cache_dir``
+config knob > the ``WUKONG_CACHE_DIR`` env form > ``<repo>/.cache/xla``.
+The knob check tolerates the console boot order (setup runs before
+load_config, so a not-yet-loaded config just falls through to env /
+default). Setup outcomes feed the device observatory's
+``wukong_device_compile_cache_total`` counter so the compile ledger's
+cold-dispatch amortization claim is checkable from a scrape, not a log.
 """
 
 from __future__ import annotations
 
 import os
 
+# the resolved directory is logged exactly once per process, not per
+# entry-point re-call (console + bench + driver all call setup)
+_logged_dir: str | None = None
+
+
+def _note(outcome: str) -> None:
+    """Charge the setup outcome on the device observatory's compile-cache
+    counter; tolerate a broken obs import (this runs at process boot)."""
+    try:
+        from wukong_tpu.obs.device import note_compile_cache
+
+        note_compile_cache(outcome)
+    except Exception:
+        pass
+
 
 def setup_persistent_cache(cache_dir: str | None = None) -> str | None:
     """Point jax at a persistent on-disk compilation cache; returns the
     directory, or None when the config knob is unavailable (old jax). Safe
     to call more than once."""
+    global _logged_dir
     import jax
 
     try:
+        if cache_dir is None:
+            try:
+                from wukong_tpu.config import Global
+
+                cache_dir = str(Global.xla_cache_dir) or None
+            except Exception:
+                cache_dir = None
         if cache_dir is None:
             repo = os.path.dirname(os.path.dirname(
                 os.path.dirname(os.path.abspath(__file__))))
@@ -31,9 +62,16 @@ def setup_persistent_cache(cache_dir: str | None = None) -> str | None:
         os.makedirs(cache_dir, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        if _logged_dir != cache_dir:
+            _logged_dir = cache_dir
+            from wukong_tpu.utils.logger import log_info
+
+            log_info(f"persistent XLA compile cache: {cache_dir}")
+        _note("available")
         return cache_dir
     except Exception as e:
         from wukong_tpu.utils.logger import log_warn
 
         log_warn(f"persistent compilation cache unavailable: {e}")
+        _note("unavailable")
         return None

@@ -148,10 +148,18 @@ def topk_device(vids, vecs, alive, anchor, k: int, metric: str):
     mask = np.zeros(cap, dtype=bool)
     mask[:n] = alive
     kk = int(min(k, cap))
+    t0 = get_usec()
     top_s, top_i = _jit_scan(metric, kk)(
         jnp.asarray(base), jnp.asarray(mask), jnp.asarray(anchor))
-    top_s = np.asarray(top_s, dtype=np.float32)
+    top_s = np.asarray(top_s, dtype=np.float32)  # blocking D2H sync
     top_i = np.asarray(top_i)
+    from wukong_tpu.obs.device import maybe_device_dispatch
+
+    maybe_device_dispatch(
+        "knn.scan", template=f"{metric}:k{kk}", live=n, capacity=cap,
+        wall_us=get_usec() - t0,
+        nbytes=int(base.nbytes) + int(mask.nbytes) + int(anchor.nbytes)
+        + 8 * kk)
     ok = np.isfinite(top_s) & (top_i < n)
     sel_v = np.asarray(vids)[top_i[ok]]
     sel_s = top_s[ok]
