@@ -537,10 +537,13 @@ def serve_main(device_ok: bool) -> None:
     through proxy.serve_query (parse cache -> plan cache -> batcher or
     direct engine). The OFF number is the seed serving path; the ON number
     coalesces compatible queries into fused chain dispatches. Also runs
-    the admission-plane overhead guard (interleaved on/off 2-hop micro —
-    the off knob must be zero-touch; p25..p75 bands must overlap).
-    Artifact: BENCH_SERVE.json with both numbers, the speedup, and the
-    `admission_overhead` detail."""
+    the overhead guards (interleaved on/off 2-hop micro — each off knob
+    must be zero-touch; p25..p75 bands must overlap) for the admission
+    plane, the device observatory, and the compiled-template route
+    chooser, plus the `device_compiled_template` rung: an unanchored
+    2-hop chain served host-walk vs whole-plan fused program.
+    Artifact: BENCH_SERVE.json with both numbers, the speedup, the
+    template headline, and the per-plane overhead detail."""
     import numpy as np
 
     from wukong_tpu.config import Global
@@ -665,6 +668,75 @@ def serve_main(device_ok: bool) -> None:
         "off": db_off, "on": db_on,
         "bands_overlap": device_bands_overlap,
     }
+
+    # COMPILED TEMPLATE serving rung: an UNANCHORED 2-hop chain (the
+    # whole advisor->memberOf join, large enough to clear the route's
+    # row floor) served through proxy.serve_query with the template
+    # route pinned host vs device — the device number is the whole plan
+    # as ONE fused XLA dispatch on the live serving path (plan cache,
+    # admission, metrics all armed). Ratio trends in bench_report; the
+    # gate is structural: the route must actually compile (programs
+    # staged, zero fallbacks) and agree with the host walk byte-for-byte
+    big_chain = (f"SELECT ?x ?y WHERE {{ ?x <{UB}advisor> ?y . "
+                 f"?y <{UB}worksFor> ?z . }}")
+    treps = int(os.environ.get("WUKONG_SERVE_TEMPLATE_REPS", "5"))
+    prev_tmpl = Global.template_device
+    tmpl_ms = {"host": None, "device": None}
+    tmpl_rows = {"host": None, "device": None}
+    try:
+        for mode in ("host", "device"):
+            Global.template_device = mode
+            for _ in range(2):  # warm plan cache + stage the program
+                proxy.serve_query(big_chain, blind=True)
+            for _ in range(treps):
+                t0 = get_usec()
+                qq = proxy.serve_query(big_chain, blind=True)
+                dt = get_usec() - t0
+                tmpl_ms[mode] = (dt if tmpl_ms[mode] is None
+                                 else min(tmpl_ms[mode], dt))
+                tmpl_rows[mode] = int(qq.result.nrows)
+        tmpl_programs = proxy.template_engine().program_count()
+    finally:
+        Global.template_device = prev_tmpl
+    device_compiled_template = (
+        round(tmpl_ms["host"] / tmpl_ms["device"], 2)
+        if tmpl_ms["host"] and tmpl_ms["device"] else None)
+    template_serving = {
+        "query": "unanchored advisor->worksFor 2-hop, blind, "
+                 "single-threaded best-of-reps",
+        "host_us": tmpl_ms["host"], "device_us": tmpl_ms["device"],
+        "ratio": device_compiled_template,
+        "rows_match": bool(tmpl_rows["host"] == tmpl_rows["device"]
+                           and tmpl_rows["host"] is not None),
+        "programs_staged": tmpl_programs,
+        "reps": treps,
+    }
+
+    # ...and the template plane's zero-touch guard: template_device
+    # "host" (plane off) vs "auto" (armed — the chooser runs, memoized
+    # off the plan cache, and routes this small anchored micro back to
+    # the walk via template_min_rows) interleaved on the same 2-hop
+    # micro; the bands must overlap or the chooser taxes every query
+    tlat = {"off": [], "on": []}
+    try:
+        for _round in range(30):
+            for mode in ("off", "on"):
+                Global.template_device = "host" if mode == "off" else "auto"
+                for _ in range(10):
+                    t0 = get_usec()
+                    proxy.serve_query(two_hop, blind=True)
+                    tlat[mode].append(get_usec() - t0)
+    finally:
+        Global.template_device = prev_tmpl
+    tb_off, tb_on = band(tlat["off"]), band(tlat["on"])
+    template_bands_overlap = (tb_off["p25_us"] <= tb_on["p75_us"]
+                              and tb_on["p25_us"] <= tb_off["p75_us"])
+    template_overhead = {
+        "query": "2-hop chain micro, single-threaded, interleaved",
+        "samples_per_mode": len(tlat["off"]),
+        "off": tb_off, "on": tb_on,
+        "bands_overlap": template_bands_overlap,
+    }
     _emit_final({
         "metric": f"LUBM-{scale} serving-path throughput, {clients} clients "
                   f"x {dur:.0f}s same-template closed loop "
@@ -674,6 +746,11 @@ def serve_main(device_ok: bool) -> None:
         "unbatched_qps": off["qps"],
         "batched_qps": on["qps"],
         "speedup": speedup,
+        # whole-plan compiled template vs host walk on the live serving
+        # path (wall ratio; backend-dependent — the structural win, one
+        # dispatch instead of a per-step sync chain, gates in
+        # BENCH_CYCLIC's compiled rung)
+        "device_compiled_template": device_compiled_template,
         "backend": "tpu" if device_ok else "cpu",
         "detail": {
             "before": off, "after": on,
@@ -684,6 +761,8 @@ def serve_main(device_ok: bool) -> None:
             "batch_metrics": batch_metrics,
             "admission_overhead": admission_overhead,
             "device_observatory": device_observatory,
+            "template_serving": template_serving,
+            "template_overhead": template_overhead,
             "dataset": DATASET_NOTES["lubm"],
         },
     }, "BENCH_SERVE.json")
@@ -701,6 +780,23 @@ def serve_main(device_ok: bool) -> None:
             f"serve drill FAILED: device-observatory on/off p50 bands "
             f"disjoint on the 2-hop micro (off={db_off}, on={db_on}) — "
             "the dispatch seam may not tax the hot path")
+    # the compiled-template headline must be REAL: the device mode must
+    # have staged+run a fused program and agreed with the host walk
+    if os.environ.get("WUKONG_SERVE_NOGATE") != "1":
+        if device_compiled_template is None or not tmpl_programs:
+            raise SystemExit(
+                "serve drill FAILED: device_compiled_template headline "
+                f"missing (ratio={device_compiled_template}, programs="
+                f"{tmpl_programs}) — the template route never compiled")
+        if not template_serving["rows_match"]:
+            raise SystemExit(
+                f"serve drill FAILED: compiled-template serving rows "
+                f"{tmpl_rows['device']} != host walk {tmpl_rows['host']}")
+        if not template_bands_overlap:
+            raise SystemExit(
+                f"serve drill FAILED: template-route on/off p50 bands "
+                f"disjoint on the 2-hop micro (off={tb_off}, on={tb_on}) "
+                "— the route chooser may not tax the hot path")
 
 
 def graphrag_main(device_ok: bool) -> None:
@@ -1563,6 +1659,19 @@ def cyclic_main(device_ok: bool) -> None:
         (v for v in device_speedups.values() if v is not None),
         default=None)
     pentagon_auto = detail["w_pentagon"]["auto_vs_walk"]
+    # the compiled-template rung: device-vs-host round trips per query
+    # (per-step device syncs over the whole-plan program's single sync),
+    # gated on the LARGE cyclic shapes — the synthetic worlds whose
+    # chains are long enough that the per-step tax is structural
+    large = [n for n, _t, _m in worlds]
+    compiled_reduction = {n: d["compiled_roundtrip_reduction"]
+                          for n, d in detail.items()}
+    compiled_device_vs_host = min(
+        (compiled_reduction[n] for n in large if compiled_reduction.get(n)),
+        default=None)
+    compiled_identical = all(
+        d["compiled_rows_identical"] in (True, None)
+        for d in detail.values())
     _emit_final({
         "metric": f"cyclic suite: WCOJ vs walk (triangle m={m_tri} "
                   f"headline; diamond/clique4 + WatDiv-{wscale} cyclic "
@@ -1586,6 +1695,16 @@ def cyclic_main(device_ok: bool) -> None:
         "device_speedup": device_speedups,
         "device_speedup_max": device_speedup_max,
         "pentagon_device_speedup": detail["w_pentagon"]["device_speedup"],
+        # COMPILED TEMPLATE rung: device<->host round trips per query,
+        # per-step route over whole-plan fused program (the program pays
+        # exactly ONE dispatch+sync; the step engine pays one per chain
+        # segment). Deterministic — gated >= 5x on the large shapes.
+        # compiled_vs_walk is the wall-clock trend (backend-dependent).
+        "compiled_roundtrip_reduction": compiled_reduction,
+        "compiled_device_vs_host": compiled_device_vs_host,
+        "compiled_vs_walk": {n: d["compiled_vs_walk"]
+                             for n, d in detail.items()},
+        "compiled_rows_identical": compiled_identical,
         "backend": "cpu",  # host walk/wcoj; the device route is the same
         # XLA kernels the TPU path jits (CPU backend in this container)
         "detail": {**detail,
@@ -1614,6 +1733,15 @@ def cyclic_main(device_ok: bool) -> None:
             raise SystemExit(
                 f"cyclic drill FAILED: best device-vs-host speedup "
                 f"{device_speedup_max} < 1.5")
+        if not compiled_identical:
+            raise SystemExit("cyclic drill FAILED: compiled-template "
+                             "rows differ from the host walk")
+        if compiled_device_vs_host is None or compiled_device_vs_host < 5.0:
+            raise SystemExit(
+                f"cyclic drill FAILED: compiled-template device-vs-host "
+                f"round-trip reduction {compiled_device_vs_host} < 5.0 "
+                "on the large cyclic shapes (the whole-plan program must "
+                "replace the per-step sync chain with ONE dispatch)")
 
 
 def _cyclic_case(name, g, stats, planner, spec, mkq, CPUEngine,
@@ -1689,6 +1817,63 @@ def _cyclic_case(name, g, stats, planner, spec, mkq, CPUEngine,
     for _ in range(reps):
         dt, settled = auto_run()
         auto_ms = dt if auto_ms is None else min(auto_ms, dt)
+    # the COMPILED TEMPLATE rung: the whole plan as ONE fused XLA program
+    # (one dispatch, one D2H sync) against the per-step device engine
+    # that pays one round trip per chain segment. The gated quantity is
+    # the device<->host round-trip reduction — dispatch records charged
+    # on the device observatory per query — which is deterministic on
+    # any backend; wall clocks ride along as trends (on the CPU backend
+    # the round trips are nearly free and compute dominates, on a real
+    # TPU each sync is the millisecond-class cost the fused program
+    # deletes, which is the whole point of compiling the template).
+    from wukong_tpu.engine.template_compile import TemplateCompiledEngine
+    from wukong_tpu.engine.tpu import TPUEngine
+    from wukong_tpu.obs.device import get_device_obs
+
+    obs = get_device_obs()
+    prev_obs = Global.enable_device_obs
+    Global.enable_device_obs = True
+    compiled_ms = compiled_trips = stepdev_trips = None
+    compiled_identical = None
+    try:
+        tce = TemplateCompiledEngine(g)
+        q = planned()
+        if tce.try_execute(q):  # stages + warms the program
+            obs.reset()
+            q = planned()
+            assert tce.try_execute(q), name
+            compiled_trips = int(
+                obs.dispatch_ledger.dispatch_counts()["count"])
+            for _ in range(reps):
+                q = planned()
+                t0 = time.perf_counter()
+                served = tce.try_execute(q)
+                dt = (time.perf_counter() - t0) * 1e3
+                assert served and q.result.status_code == 0, name
+                compiled_ms = (dt if compiled_ms is None
+                               else min(compiled_ms, dt))
+            # one non-blind run folded into the byte-identity posture
+            q = planned()
+            q.result.blind = False
+            assert tce.try_execute(q), name
+            compiled_identical = bool(
+                q.result.nrows == walk_rows
+                and {tuple(r) for r in q.result.table.tolist()} == walk_set)
+            # the per-step device baseline: ONE execution, count its
+            # charged sync points (counts are shape-determined, not
+            # timing-dependent, so a single run is exact)
+            try:
+                tpu = TPUEngine(g, stats=stats)
+                q = planned()
+                obs.reset()
+                tpu.execute(q)
+                assert q.result.status_code == 0, name
+                stepdev_trips = int(
+                    obs.dispatch_ledger.dispatch_counts()["count"])
+            except Exception:
+                stepdev_trips = None  # shape the step engine can't run
+    finally:
+        Global.enable_device_obs = prev_obs
     return {
         "walk_ms": round(walk_ms, 1), "wcoj_ms": round(wcoj_ms, 1),
         "speedup": round(walk_ms / wcoj_ms, 2) if wcoj_ms else None,
@@ -1706,6 +1891,18 @@ def _cyclic_case(name, g, stats, planner, spec, mkq, CPUEngine,
         "auto_ms": round(auto_ms, 1),
         "auto_vs_walk": round(walk_ms / auto_ms, 2) if auto_ms else None,
         "est_peak_over_final": _est_ratio(planner, planned()),
+        # None throughout = the shape has no compilable template (the
+        # host walk serves it; nothing to gate)
+        "compiled_ms": (round(compiled_ms, 1)
+                        if compiled_ms is not None else None),
+        "compiled_vs_walk": (round(walk_ms / compiled_ms, 2)
+                             if compiled_ms else None),
+        "compiled_roundtrips": compiled_trips,
+        "stepdev_roundtrips": stepdev_trips,
+        "compiled_roundtrip_reduction": (
+            round(stepdev_trips / compiled_trips, 1)
+            if compiled_trips and stepdev_trips else None),
+        "compiled_rows_identical": compiled_identical,
     }
 
 

@@ -54,6 +54,17 @@ SECONDARY_HEADLINES = (
     # trended beside the hybrid headline so a vector-plane tax on graph
     # traffic shows up as a divergence between the two series
     ("graph_qps", "q/s"),
+    # BENCH_CYCLIC's compiled-template rung: device<->host round trips
+    # per query, per-step device route over the whole-plan fused program
+    # (min across the large cyclic shapes; deterministic — cyclic_main
+    # self-gates it >= 5x, so unit "x" trends it without a second check)
+    ("compiled_device_vs_host", "x"),
+    # BENCH_SERVE's whole-plan-compiled vs host-walk wall ratio on the
+    # live serving path (unit "x" is direction-less: on the CPU backend
+    # the sync chain the program deletes is nearly free, so the ratio is
+    # trended, while serve_main gates the structural facts — programs
+    # staged, rows identical, route chooser zero-touch)
+    ("device_compiled_template", "x"),
 )
 
 LOWER_BETTER = ("us", "ms", "ns", "sec")

@@ -34,9 +34,22 @@ JAX_PLATFORMS=cpu python bench.py --readmostly
 echo "== cyclic device-route drill (WCOJ host/device/walk identity) =="
 # the cyclic suite with the XLA device route: every case byte-identical
 # across walk / host-wcoj / device-wcoj, the w_pentagon auto-routing
-# exception closed (auto >= 1.0 vs the walk), and >= 1.5x device-vs-host
-# on at least one case (exits non-zero otherwise; see cyclic_main gates)
+# exception closed (auto >= 1.0 vs the walk), >= 1.5x device-vs-host
+# on at least one case, AND the compiled-template rung: the whole-plan
+# fused program must answer byte-identically to the walk and delete
+# >= 5x of the per-step device route's host<->device round trips on the
+# large cyclic shapes (exits non-zero otherwise; see cyclic_main gates)
 JAX_PLATFORMS=cpu python bench.py --cyclic
+
+echo "== serving drill (batching + compiled template + zero-touch) =="
+# the serving-path suite: batched-vs-unbatched qps, the
+# device_compiled_template rung (unanchored 2-hop via the whole-plan
+# fused program — must stage, agree with the host walk, and leave the
+# 2-hop micro's latency band untouched with the route chooser armed),
+# and the admission / device-observatory zero-touch band guards (exits
+# non-zero otherwise; see serve_main gates). Short closed loop: the
+# qps headline trends, the gates are structural
+WUKONG_SERVE_DURATION=4 JAX_PLATFORMS=cpu python bench.py --serve-batched
 
 echo "== device-cost drill (padding efficiency + cold amortization) =="
 # the cyclic device-route suite run twice with the device observatory

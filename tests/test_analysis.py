@@ -824,3 +824,69 @@ def test_device_gate_skips_trees_without_device_plane(tmp_path):
     tree = write_tree(tmp_path / "plain", {
         "engine/tpu.py": "import jax\nf = jax.jit(lambda x: x)\n"})
     assert run_analysis(tree, plugins=["device-telemetry"]) == []
+
+
+_DEV_OK = (
+    "declare_leaf('device.dispatch')\n"
+    "DEVICE_INPUTS = {'dispatches': 'wukong_device_d_total',"
+    " 'padding_efficiency': 'wukong_device_pe'}\n"
+    "DEVICE_DISPATCH_ALLOWLIST = {}\n"
+    "def reg(r):\n"
+    "    r.counter('wukong_device_d_total', 'h')\n"
+    "    r.gauge('wukong_device_pe', 'h')\n")
+
+
+def test_template_coherence_fixtures(tmp_path):
+    """PR 19's actuator contract: the compiled-program cache key fills
+    on store version + the route-knob set, TEMPLATE_ROUTES is a literal
+    registry, and the route chooser's every signal read is a
+    read_device_input() call against a declared DEVICE_INPUTS member —
+    never a direct reach into the observatory."""
+    from wukong_tpu.analysis import run_analysis
+
+    bad = write_tree(tmp_path / "bad", {
+        "obs/device.py": _DEV_OK,
+        "engine/template_compile.py": (
+            # no TEMPLATE_ROUTES literal; key ignores store version and
+            # knobs; chooser reads a ghost signal, a non-literal signal,
+            # and pokes the observatory directly
+            "def _program_key(tsig, caps):\n"
+            "    return (tsig, tuple(caps))\n"
+            "def choose_template_route(tsig, est):\n"
+            "    sig = 'pad' + 'ding'\n"
+            "    read_device_input(sig)\n"
+            "    read_device_input('ghost_signal')\n"
+            "    return 'device' if _observatory else 'host'\n")})
+    msgs = "\n".join(str(v) for v in
+                     run_analysis(bad, plugins=["device-telemetry"]))
+    assert "TEMPLATE_ROUTES" in msgs
+    assert "store_version" in msgs
+    assert "knob" in msgs
+    assert "non-literal signal" in msgs
+    assert "ghost_signal" in msgs
+    assert "directly" in msgs
+
+    good = write_tree(tmp_path / "good", {
+        "obs/device.py": _DEV_OK,
+        "engine/template_compile.py": (
+            "TEMPLATE_ROUTES = {'device': 'fused whole-plan program',"
+            " 'host': 'the NumPy walk'}\n"
+            "def _route_knobs():\n"
+            "    return (str(Global.template_device),)\n"
+            "def _program_key(tsig, store_version, caps):\n"
+            "    return (tsig, store_version, tuple(caps),"
+            " _route_knobs())\n"
+            "def choose_template_route(tsig, est):\n"
+            "    eff = read_device_input('padding_efficiency')\n"
+            "    n = read_device_input('dispatches')\n"
+            "    return 'host' if eff is None else 'device'\n")})
+    assert run_analysis(good, plugins=["device-telemetry"]) == []
+
+
+def test_template_coherence_skips_trees_without_template_plane(tmp_path):
+    """A device plane without the compiled-template engine (PR 18
+    trees) is exempt from the template-coherence checks."""
+    from wukong_tpu.analysis import run_analysis
+
+    tree = write_tree(tmp_path / "pre", {"obs/device.py": _DEV_OK})
+    assert run_analysis(tree, plugins=["device-telemetry"]) == []

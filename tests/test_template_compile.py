@@ -1,0 +1,725 @@
+"""Whole-plan compiled template execution (ISSUE 19 acceptance).
+
+The acceptance bar: the fused XLA program returns BYTE-IDENTICAL result
+rows — including row order — to the host walk across chain, const-start,
+index-start, filter (known-known / known-const / const-known) and
+projection shapes plus six cyclic cases; a compile-time or mid-flight
+dispatch fault degrades the SAME query to the walk (SUCCESS, identical
+bytes, fallback counted, per-template demotion latched); a dynamic
+insert makes stale programs unreachable and re-arms the latch; the
+program cache evicts under ``template_budget_mb``; and the stream-epoch
+/ view-maintenance device frontier is byte-identical to the host
+oracle. The serve-path drills run fully lockdep-checked.
+"""
+
+import numpy as np
+import pytest
+
+from wukong_tpu.config import Global
+from wukong_tpu.engine.cpu import CPUEngine
+from wukong_tpu.engine.template_compile import (
+    TEMPLATE_ROUTES,
+    TemplateCompiledEngine,
+    choose_template_route,
+    demotion_report,
+    extract_template,
+    is_demoted,
+    latch_demotion,
+    reset_demotions,
+)
+from wukong_tpu.loader.datagen import (
+    CyclicStrings,
+    cyclic_query_text,
+    generate_clique4,
+    generate_diamond,
+    generate_triangle,
+)
+from wukong_tpu.planner.heuristic import heuristic_plan
+from wukong_tpu.planner.optimizer import Planner
+from wukong_tpu.planner.stats import Stats
+from wukong_tpu.runtime import faults
+from wukong_tpu.runtime.faults import FaultPlan, FaultSpec
+from wukong_tpu.runtime.proxy import Proxy
+from wukong_tpu.sparql.ir import Pattern, SPARQLQuery
+from wukong_tpu.store.gstore import build_partition
+from wukong_tpu.types import IN, OUT, PREDICATE_ID
+from wukong_tpu.utils.errors import ErrorCode
+
+pytestmark = pytest.mark.template
+
+WORLDS = {
+    "triangle": lambda: generate_triangle(m=60, noise=3, seed=1),
+    "diamond": lambda: generate_diamond(m=40, noise=2, seed=1),
+    "clique4": lambda: generate_clique4(n=120, fan=6, ncliques=8, seed=1),
+}
+
+
+@pytest.fixture(scope="module", params=sorted(WORLDS))
+def world(request):
+    triples, meta = WORLDS[request.param]()
+    g = build_partition(triples, 0, 1)
+    stats = Stats.generate(triples)
+    return request.param, triples, g, stats, meta
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    """Every test starts with no fault plan, no demotion latches, a
+    clean observatory, and the template knobs at their defaults
+    (monkeypatch rolls any per-test knob override back)."""
+    from wukong_tpu.obs.device import get_device_obs
+
+    faults.clear()
+    reset_demotions()
+    get_device_obs().reset()
+    monkeypatch.setattr(Global, "template_device", "auto")
+    monkeypatch.setattr(Global, "template_min_rows", 4096)
+    monkeypatch.setattr(Global, "template_capacity_retries", 3)
+    monkeypatch.setattr(Global, "template_budget_mb", 256)
+    monkeypatch.setattr(Global, "template_demote_eff", 0.02)
+    monkeypatch.setattr(Global, "join_strategy", "auto")
+    monkeypatch.setattr(Global, "join_device_min_candidates", 65536)
+    yield
+    faults.clear()
+    reset_demotions()
+    get_device_obs().reset()
+
+
+def mkq(meta, blind=False) -> SPARQLQuery:
+    q = SPARQLQuery()
+    q.pattern_group.patterns = [Pattern(s, p, OUT, o)
+                                for (s, p, o) in meta["patterns"]]
+    q.result.nvars = len(meta["vars"])
+    q.result.required_vars = list(meta["vars"])
+    q.result.blind = blind
+    return q
+
+
+def handq(pats, vars_, blind=False) -> SPARQLQuery:
+    """A query with an explicit pattern order (no planner reordering):
+    the shape-matrix tests pin each fused op kind this way."""
+    q = SPARQLQuery()
+    q.pattern_group.patterns = [Pattern(s, p, d, o) for (s, p, d, o) in pats]
+    q.result.nvars = len(vars_)
+    q.result.required_vars = list(vars_)
+    q.result.blind = blind
+    return q
+
+
+def assert_identical(qh: SPARQLQuery, qc: SPARQLQuery) -> None:
+    """Byte identity INCLUDING row order — the compiled path's contract
+    is the host walk's exact reply, not a row-set match."""
+    assert qh.result.status_code == qc.result.status_code
+    assert qh.result.nrows == qc.result.nrows
+    assert qh.result.col_num == qc.result.col_num
+    assert qh.result.v2c_map == qc.result.v2c_map
+    th = np.asarray(qh.result.table)
+    tc = np.asarray(qc.result.table)
+    assert th.dtype == tc.dtype
+    assert th.shape == tc.shape
+    assert np.array_equal(th, tc)
+
+
+def run_pair(g, build, plan=False):
+    """(host walk, compiled) executions of the same query builder."""
+    qh = build()
+    if plan:
+        heuristic_plan(qh)
+    CPUEngine(g).execute(qh)
+    qc = build()
+    if plan:
+        heuristic_plan(qc)
+    served = TemplateCompiledEngine(g).try_execute(qc)
+    return qh, qc, served
+
+
+# ---------------------------------------------------------------------------
+# byte identity: six cyclic cases (three worlds x projected/blind)
+# ---------------------------------------------------------------------------
+
+def test_compiled_matches_walk_cyclic(world):
+    name, _triples, g, _stats, meta = world
+    qh, qc, served = run_pair(g, lambda: mkq(meta), plan=True)
+    assert served, name
+    assert qc._template_compiled
+    assert_identical(qh, qc)
+
+
+def test_compiled_matches_walk_cyclic_blind(world):
+    """Blind replies take the unfused path: the full table plus the
+    host engine's ``_final_process`` replayed verbatim."""
+    name, _triples, g, _stats, meta = world
+    qh, qc, served = run_pair(g, lambda: mkq(meta, blind=True), plan=True)
+    assert served, name
+    assert qh.result.status_code == qc.result.status_code
+    assert qh.result.nrows == qc.result.nrows, name
+
+
+# ---------------------------------------------------------------------------
+# byte identity: the fused-op shape matrix (hand-ordered plans)
+# ---------------------------------------------------------------------------
+
+def _tri_world():
+    triples, meta = generate_triangle(m=60, noise=3, seed=1)
+    return triples, build_partition(triples, 0, 1), meta
+
+
+def test_const_start_chain_identity():
+    triples, g, _meta = _tri_world()
+    a = int(triples[triples[:, 1] == 2][0, 0])
+    qh, qc, served = run_pair(
+        g, lambda: handq([(a, 2, OUT, -1), (-1, 3, OUT, -2)], [-1, -2]))
+    assert served
+    spec = extract_template(handq([(a, 2, OUT, -1), (-1, 3, OUT, -2)],
+                                  [-1, -2]))
+    assert [op[0] for op in spec[0]] == ["const_list", "expand"]
+    assert_identical(qh, qc)
+
+
+def test_index_start_chain_identity():
+    _triples, g, _meta = _tri_world()
+    pats = [(2, PREDICATE_ID, IN, -1), (-1, 2, OUT, -2), (-2, 3, OUT, -3)]
+    qh, qc, served = run_pair(g, lambda: handq(pats, [-1, -2, -3]))
+    assert served
+    spec = extract_template(handq(pats, [-1, -2, -3]))
+    assert [op[0] for op in spec[0]] == ["index", "expand", "expand"]
+    assert_identical(qh, qc)
+
+
+def test_filter_pair_const_identity():
+    triples, g, _meta = _tri_world()
+    c = int(triples[triples[:, 1] == 4][0, 2])
+    pats = [(2, PREDICATE_ID, IN, -1), (-1, 2, OUT, -2), (-1, 4, OUT, c)]
+    qh, qc, served = run_pair(g, lambda: handq(pats, [-1, -2]))
+    assert served
+    spec = extract_template(handq(pats, [-1, -2]))
+    assert [op[0] for op in spec[0]] == ["index", "expand",
+                                         "filter_pair_const"]
+    assert qh.result.nrows > 0  # a vacuous filter proves nothing
+    assert_identical(qh, qc)
+
+
+def test_filter_member_identity():
+    triples, g, _meta = _tri_world()
+    a = int(triples[triples[:, 1] == 2][0, 0])
+    pats = [(3, PREDICATE_ID, IN, -1), (a, 2, OUT, -1)]
+    qh, qc, served = run_pair(g, lambda: handq(pats, [-1]))
+    assert served
+    spec = extract_template(handq(pats, [-1]))
+    assert [op[0] for op in spec[0]] == ["index", "filter_member"]
+    assert qh.result.nrows > 0
+    assert_identical(qh, qc)
+
+
+def test_projection_subset_fused_identity():
+    """A strict-subset projection fuses on device (only the projected
+    columns come back) and still matches the walk's reply bytes."""
+    _triples, g, _meta = _tri_world()
+    pats = [(2, PREDICATE_ID, IN, -1), (-1, 2, OUT, -2), (-2, 3, OUT, -3)]
+    qh, qc, served = run_pair(g, lambda: handq(pats, [-3]))
+    assert served
+    spec = extract_template(handq(pats, [-3]))
+    assert spec[2] == (2,)  # proj fused to the one required column
+    assert qc.result.col_num == 1
+    assert_identical(qh, qc)
+
+
+def test_distinct_replays_host_final_process():
+    """DISTINCT keeps the full fused table and replays the host
+    ``_final_process`` verbatim — reply bytes identical to the walk."""
+    _triples, g, _meta = _tri_world()
+    pats = [(2, PREDICATE_ID, IN, -1), (-1, 2, OUT, -2)]
+
+    def build():
+        q = handq(pats, [-2])
+        q.distinct = True
+        return q
+
+    qh, qc, served = run_pair(g, build)
+    assert served
+    assert extract_template(build())[2] is None  # proj NOT fused
+    assert_identical(qh, qc)
+
+
+def test_unsupported_shapes_leave_query_untouched():
+    """FILTER / OPTIONAL / deadline shapes are refused (False) with the
+    query untouched — the walk owns them, nothing is latched."""
+    _triples, g, _meta = _tri_world()
+    eng = TemplateCompiledEngine(g)
+
+    q = handq([(2, PREDICATE_ID, IN, -1), (-1, 2, OUT, -2)], [-1, -2])
+    q.pattern_group.filters = [object()]
+    assert not eng.try_execute(q)
+    assert q.pattern_step == 0 and q.result.table.size == 0
+
+    q2 = handq([(2, PREDICATE_ID, IN, -1), (-1, 2, OUT, -2)], [-1, -2])
+    q2.mt_factor = 4
+    assert not eng.try_execute(q2)
+    assert demotion_report() == {}  # refusal is not a failure
+
+
+# ---------------------------------------------------------------------------
+# capacity classes: retry growth + overflow ceiling
+# ---------------------------------------------------------------------------
+
+def test_capacity_retry_regrows_and_matches(monkeypatch):
+    """Deliberately undersized capacity classes overflow, regrow
+    (``_grow_caps``) and converge to the identical reply — the good
+    classes are memoized so the next query dispatches once."""
+    from wukong_tpu.obs.device import get_device_obs, read_device_input
+
+    monkeypatch.setattr(Global, "enable_device_obs", True)
+    get_device_obs().reset()
+    _triples, g, meta = _tri_world()
+
+    def build():
+        q = mkq(meta)
+        heuristic_plan(q)
+        return q
+
+    spec, _v2c, _proj, _width = extract_template(build())
+    eng = TemplateCompiledEngine(g)
+    version = eng._version()
+    eng._good_caps[(spec, version)] = (128, 64, 64)  # far too small
+    qc = build()
+    assert eng.try_execute(qc)
+    qh = build()
+    CPUEngine(g).execute(qh)
+    assert_identical(qh, qc)
+    counts = read_device_input("dispatches", "template.plan")
+    assert int(counts["count"]) >= 2  # at least one overflow retry
+    assert eng._good_caps[(spec, version)] != (128, 64, 64)
+
+
+def test_overflow_past_ceiling_degrades_on_serve_path():
+    """When the capacity ceiling makes the template untenable the serve
+    path degrades to the walk — SUCCESS, identical bytes, fallback
+    counted, per-template demotion latched."""
+    proxy, text = _mk_tri_proxy()
+    Global.join_strategy = "walk"
+    Global.template_device = "host"
+    qw = proxy.run_single_query(text, blind=False)
+    Global.template_device = "device"
+    old_max = Global.table_capacity_max
+    old_min = Global.table_capacity_min
+    Global.table_capacity_min = 64
+    Global.table_capacity_max = 128
+    try:
+        before = _fallbacks(proxy)
+        q = proxy.run_single_query(text, blind=False)
+    finally:
+        Global.table_capacity_max = old_max
+        Global.table_capacity_min = old_min
+    assert q.result.status_code == ErrorCode.SUCCESS
+    assert not getattr(q, "_template_compiled", False)
+    assert_identical(qw, q)
+    assert _fallbacks(proxy) == before + 1
+    assert "TemplateOverflow" in demotion_report().values()
+
+
+# ---------------------------------------------------------------------------
+# the route chooser (TEMPLATE_ROUTES contract)
+# ---------------------------------------------------------------------------
+
+def test_route_chooser_knobs_and_thresholds():
+    sig = ("t", 1)
+    Global.template_device = "host"
+    assert choose_template_route(sig, 10 ** 6) == "host"
+    Global.template_device = "device"
+    assert choose_template_route(sig, None) == "device"
+    Global.template_device = "auto"
+    Global.template_min_rows = 1000
+    assert choose_template_route(sig, 999) == "host"
+    assert choose_template_route(sig, None) == "host"
+    assert choose_template_route(sig, 1000) == "device"
+    assert set(TEMPLATE_ROUTES) == {"device", "host", "latched_host"}
+
+
+def test_demotion_latch_and_store_version_rearm():
+    sig = ("t", 2)
+    latch_demotion(sig, "compile_failed", version=7)
+    assert is_demoted(sig, 7)
+    Global.template_device = "device"
+    assert choose_template_route(sig, 10 ** 6, version=7) == "latched_host"
+    # a store mutation re-arms the device attempt
+    assert not is_demoted(sig, 8)
+    assert choose_template_route(sig, 10 ** 6, version=8) == "device"
+    assert "compile_failed" in demotion_report().values()
+    reset_demotions()
+    assert demotion_report() == {}
+
+
+def test_low_efficiency_feedback_latches_host(monkeypatch):
+    """Measured demotion: a template site whose warm padding efficiency
+    collapsed (read ONLY through ``read_device_input``) latches host
+    after enough dispatches."""
+    from wukong_tpu.obs.device import get_device_obs, maybe_device_dispatch
+
+    monkeypatch.setattr(Global, "enable_device_obs", True)
+    get_device_obs().reset()
+    Global.template_device = "auto"
+    Global.template_min_rows = 1
+    Global.template_demote_eff = 0.5
+    sig = ("t", 3)
+    for _ in range(8):
+        maybe_device_dispatch("template.plan", template="tx", live=1,
+                              capacity=4096, wall_us=10, nbytes=0)
+    assert choose_template_route(sig, 10 ** 6, version=0) == "latched_host"
+    assert "low_efficiency" in demotion_report().values()
+
+
+# ---------------------------------------------------------------------------
+# serve-path: chaos degrade, invalidation, feedback, EXPLAIN (lockdep)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def lockdep_checked():
+    from wukong_tpu.analysis import lockdep
+
+    lockdep.install(True)
+    yield
+    try:
+        assert lockdep.cycles() == [], lockdep.cycles()
+        assert lockdep.leaf_violations() == [], lockdep.leaf_violations()
+    finally:
+        lockdep.install(False)
+
+
+def _mk_tri_proxy():
+    triples, meta = generate_triangle(m=60, noise=3, seed=1)
+    g = build_partition(triples, 0, 1)
+    ss = CyclicStrings(meta)
+    stats = Stats.generate(triples)
+    proxy = Proxy(g, ss, cpu_engine=CPUEngine(g, ss),
+                  planner=Planner(stats))
+    return proxy, cyclic_query_text(meta)
+
+
+@pytest.fixture()
+def tri_proxy():
+    return _mk_tri_proxy()
+
+
+def _fallbacks(proxy) -> float:
+    total = 0.0
+    for s in proxy.metrics.snapshot().get(
+            "wukong_template_fallback_total", {}).get("series", []):
+        total += s["value"]
+    return total
+
+
+def test_serve_path_routes_device_and_matches_walk(tri_proxy,
+                                                   lockdep_checked):
+    proxy, text = tri_proxy
+    Global.join_strategy = "walk"
+    Global.template_device = "host"
+    qw = proxy.run_single_query(text, blind=False)
+    assert getattr(qw, "template_route", None) == "host"
+    Global.template_device = "device"
+    qd = proxy.run_single_query(text, blind=False)
+    assert qd.template_route == "device"
+    assert qd._template_compiled
+    assert_identical(qw, qd)
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("site", ["template.compile", "template.dispatch"])
+def test_template_fault_degrades_to_walk_and_latches(tri_proxy, site,
+                                                     lockdep_checked):
+    """An injected compile-time or MID-FLIGHT dispatch transient fires
+    with the query untouched; the serve path degrades the SAME query to
+    the walk (SUCCESS, identical bytes, fallback counted) and latches
+    the per-template demotion so the next query never re-pays the
+    failed device attempt."""
+    proxy, text = tri_proxy
+    Global.join_strategy = "walk"
+    Global.template_device = "host"
+    qw = proxy.run_single_query(text, blind=False)
+    Global.template_device = "device"
+    before = _fallbacks(proxy)
+    faults.install(FaultPlan([FaultSpec(site=site, kind="transient")],
+                             seed=7))
+    q = proxy.run_single_query(text, blind=False)
+    faults.clear()
+    assert q.result.status_code == ErrorCode.SUCCESS
+    assert q.result.complete
+    assert not getattr(q, "_template_compiled", False)
+    assert_identical(qw, q)
+    assert _fallbacks(proxy) == before + 1
+    assert "TransientFault" in demotion_report().values()
+    # the latch routes the next same-template query straight to host
+    q2 = proxy.run_single_query(text, blind=False)
+    assert q2.template_route == "latched_host"
+    assert_identical(qw, q2)
+
+
+def test_store_version_invalidation_via_dynamic_insert(tri_proxy,
+                                                       lockdep_checked):
+    """A dynamic insert bumps the store version: the next compiled
+    execution sees the new rows (stale programs are unreachable AND
+    reaped from the cache) — byte-identical to the host walk on the
+    mutated store."""
+    from wukong_tpu.store.dynamic import insert_triples
+    from wukong_tpu.types import NORMAL_ID_START
+
+    proxy, text = tri_proxy
+    Global.join_strategy = "walk"
+    Global.template_device = "device"
+    base = proxy.run_single_query(text, blind=False)
+    assert base._template_compiled
+    a, b, c = (NORMAL_ID_START + 7001, NORMAL_ID_START + 7002,
+               NORMAL_ID_START + 7003)
+    insert_triples(proxy.g, np.asarray(
+        [[a, 2, b], [b, 3, c], [a, 4, c]], dtype=np.int64))
+    q = proxy.run_single_query(text, blind=False)
+    assert q._template_compiled
+    rows = set(map(tuple, q.result.table.tolist()))
+    base_rows = set(map(tuple, base.result.table.tolist()))
+    assert rows - base_rows == {(a, b, c)}
+    Global.template_device = "host"
+    qw = proxy.run_single_query(text, blind=False)
+    assert_identical(qw, q)
+    # every cached program is keyed at the post-insert version
+    eng = proxy.template_engine()
+    version = int(proxy.g.version)
+    assert eng.program_count() >= 1
+    assert all(k[1] == version for k in eng._programs)
+
+
+def test_small_measured_feedback_demotes_auto_route(tri_proxy,
+                                                    monkeypatch):
+    """Under ``auto`` a successful compiled run whose MEASURED live
+    rows undershoot ``template_min_rows`` latches the template back to
+    host — the estimate over-predicted."""
+    from wukong_tpu.obs.device import get_device_obs
+
+    monkeypatch.setattr(Global, "enable_device_obs", True)
+    get_device_obs().reset()
+    proxy, text = tri_proxy
+    Global.join_strategy = "walk"
+    Global.template_device = "auto"
+    Global.template_min_rows = 1000  # est 1500 routes device; live 715
+    q = proxy.run_single_query(text, blind=False)
+    assert q.template_route == "device"
+    assert q._template_compiled
+    assert "small_measured" in demotion_report().values()
+    q2 = proxy.run_single_query(text, blind=False)
+    assert q2.template_route == "latched_host"
+
+
+def test_explain_renders_template_compiled_route(tri_proxy, monkeypatch):
+    """EXPLAIN / EXPLAIN ANALYZE (satellite b): the route line says
+    ``template-compiled`` and the per-step device table carries the
+    whole-plan compiled row."""
+    from wukong_tpu.obs.device import get_device_obs
+
+    monkeypatch.setattr(Global, "enable_device_obs", True)
+    get_device_obs().reset()
+    proxy, text = tri_proxy
+    Global.join_strategy = "walk"
+    Global.template_device = "device"
+    rep = proxy.explain_query(text, analyze=True)
+    assert rep["route"] == "template-compiled"
+    assert "route: template-compiled" in rep["rendered"]
+    steps = [r for r in rep.get("device_steps", [])
+             if r.get("site") == "template.plan"]
+    assert len(steps) == 1  # the whole plan is ONE dispatch
+    assert steps[0]["live"] == 715
+
+
+# ---------------------------------------------------------------------------
+# program cache: residency budget eviction
+# ---------------------------------------------------------------------------
+
+def test_budget_eviction_under_template_budget_mb(monkeypatch):
+    """Two oversized programs cannot co-reside under a 1 MB budget: the
+    LRU victim is evicted with its bytes charged on the residency
+    ledger (kind ``template``)."""
+    from wukong_tpu.obs.device import get_device_obs, read_device_input
+
+    monkeypatch.setattr(Global, "enable_device_obs", True)
+    monkeypatch.setattr(Global, "template_budget_mb", 1)
+    monkeypatch.setattr(Global, "table_capacity_min", 1 << 16)
+    get_device_obs().reset()
+    triples, g, _meta = _tri_world()
+    a = int(triples[triples[:, 1] == 2][0, 0])
+    eng = TemplateCompiledEngine(g)
+    q1 = handq([(a, 2, OUT, -1), (-1, 3, OUT, -2)], [-1, -2])
+    assert eng.try_execute(q1)
+    assert eng.program_count() == 1
+    t1 = read_device_input("resident_bytes").get("template", 0)
+    q2 = handq([(2, PREDICATE_ID, IN, -1), (-1, 2, OUT, -2)], [-1, -2])
+    assert eng.try_execute(q2)
+    assert eng.program_count() == 1  # the first program was evicted
+    cached = sum(p.nbytes for p in eng._programs.values())
+    t2 = read_device_input("resident_bytes").get("template", 0)
+    assert t2 == cached  # the victim's bytes were charged back (evict)
+    assert t2 < t1 + cached  # ... not accumulated alongside the fill
+    # the evicted template re-executes correctly (cache miss, restage)
+    q3 = handq([(a, 2, OUT, -1), (-1, 3, OUT, -2)], [-1, -2])
+    qh = handq([(a, 2, OUT, -1), (-1, 3, OUT, -2)], [-1, -2])
+    CPUEngine(g).execute(qh)
+    assert eng.try_execute(q3)
+    assert_identical(qh, q3)
+
+
+def test_program_key_includes_route_knobs():
+    """A runtime knob flip can never serve a program chosen under
+    different routing rules: the knob set joins the cache key."""
+    from wukong_tpu.engine.template_compile import _program_key
+
+    Global.template_device = "auto"
+    k1 = _program_key(("t",), 0, (1024,))
+    Global.template_device = "device"
+    k2 = _program_key(("t",), 0, (1024,))
+    assert k1 != k2
+    assert _program_key(("t",), 1, (1024,)) != k2  # version joins too
+
+
+# ---------------------------------------------------------------------------
+# consumers: stream-epoch + view-maintenance device frontier
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def lubm_world():
+    from wukong_tpu.loader.lubm import VirtualLubmStrings, generate_lubm
+
+    triples, _lay = generate_lubm(1, seed=42)
+    ss = VirtualLubmStrings(1, seed=42)
+    rng = np.random.default_rng(0)
+    perm = rng.permutation(len(triples))
+    return triples, ss, perm
+
+
+PREFIX = """
+PREFIX rdf: <http://www.w3.org/1999/02/22-rdf-syntax-ns#>
+PREFIX ub: <http://swat.cse.lehigh.edu/onto/univ-bench.owl#>
+"""
+Q_CHAIN = PREFIX + """SELECT ?X ?Y ?Z WHERE {
+    ?X ub:memberOf ?Y .
+    ?Y ub:subOrganizationOf ?Z .
+}"""
+Q_ONEHOP = PREFIX + "SELECT ?X ?Y WHERE { ?X ub:advisor ?Y . }"
+
+
+def _stream_result(triples, ss, perm, text, knob):
+    from wukong_tpu.stream import ReplaySource, StreamContext
+
+    Global.template_device = knob
+    base = triples[perm[:len(triples) // 2]]
+    live = triples[perm[len(triples) // 2:]]
+    ctx = StreamContext([build_partition(base, 0, 1)], ss)
+    qid = ctx.register(text)
+    ctx.feed_source(ReplaySource(live, batch_size=4096))
+    return ctx.result_set(qid)
+
+
+@pytest.mark.stream
+def test_stream_epoch_device_frontier_matches_host_oracle(lubm_world,
+                                                          monkeypatch):
+    """The fully device-evaluated stream frontier (``template_device
+    device`` forces the fused seed extraction for every epoch) converges
+    to the byte-identical standing result of the host path."""
+    from wukong_tpu.obs.device import get_device_obs, read_device_input
+
+    monkeypatch.setattr(Global, "enable_device_obs", True)
+    get_device_obs().reset()
+    triples, ss, perm = lubm_world
+    host = _stream_result(triples, ss, perm, Q_CHAIN, "host")
+    dev = _stream_result(triples, ss, perm, Q_CHAIN, "device")
+    assert host.shape == dev.shape
+    assert np.array_equal(host, dev)
+    counts = read_device_input("dispatches", "stream.seed_extract")
+    assert int(counts["count"]) > 0  # the device frontier actually ran
+
+
+@pytest.mark.stream
+def test_device_seed_extract_gating_and_parity(lubm_world):
+    """The fused extraction is knob-gated (host -> None, auto under the
+    amortization floor -> None) and byte-identical to ``match_delta``
+    per term when it runs."""
+    from wukong_tpu.stream.continuous import (device_seed_extract,
+                                              match_delta)
+
+    triples, ss, _perm = lubm_world
+    from wukong_tpu.sparql.parser import Parser
+
+    q = Parser(ss).parse(Q_CHAIN)
+    pats = list(q.pattern_group.patterns)
+    batch = triples[:4096]
+
+    Global.template_device = "host"
+    assert device_seed_extract(pats, batch) is None
+    Global.template_device = "auto"
+    Global.join_device_min_candidates = 1 << 60
+    assert device_seed_extract(pats, batch) is None
+
+    Global.template_device = "device"
+    seeds = device_seed_extract(pats, batch)
+    assert seeds is not None and len(seeds) == len(pats)
+    for (vars_d, seed_d), pat in zip(seeds, pats):
+        vars_h, seed_h = match_delta(pat, batch)
+        assert vars_d == vars_h
+        assert np.array_equal(seed_d, seed_h)
+
+
+@pytest.mark.serve
+def test_view_maintenance_device_union_matches_host(lubm_world,
+                                                    monkeypatch):
+    """Consumer 3: an epoch's per-view semi-naive term unions batch into
+    one fused device frontier — survivor decisions and the standing
+    seen-set stay byte-identical to the host path."""
+    from wukong_tpu.serve.views import ViewRegistry
+
+    monkeypatch.setattr(Global, "enable_views", True)
+    monkeypatch.setattr(Global, "enable_device_obs", True)
+    triples, ss, perm = lubm_world
+    base = triples[perm[:len(triples) // 2]]
+    batches = [triples[perm[len(triples) // 2:len(triples) // 2 + 2048]],
+               triples[perm[len(triples) // 2 + 2048:
+                            len(triples) // 2 + 4096]]]
+
+    def drive(knob):
+        Global.template_device = knob
+        g = build_partition(base, 0, 1)
+        vr = ViewRegistry()
+        vr.attach(g, ss)
+        assert vr.promote(("m-chain",), Q_CHAIN)
+        assert vr.promote(("m-onehop",), Q_ONEHOP)
+        out = []
+        for i, batch in enumerate(batches):
+            out.append(vr.on_mutation(batch, version=i + 1))
+        seen = {m: sorted(vr._ce.queries[v.qid].seen)
+                for m, v in vr._views.items()}
+        return out, seen
+
+    host_surv, host_seen = drive("host")
+    dev_surv, dev_seen = drive("device")
+    assert host_surv == dev_surv
+    assert host_seen == dev_seen
+
+
+# ---------------------------------------------------------------------------
+# consumer: device-side slice settlement in the distributed join
+# ---------------------------------------------------------------------------
+
+def test_dist_settle_device_concat_matches_host(monkeypatch):
+    """Consumer 1: the gather thread's slice settlement concatenates
+    padded per-slice tables on device — byte-identical (row order
+    included) to ``np.concatenate`` over the same slices."""
+    from wukong_tpu.join.dist import DistributedWCOJExecutor
+
+    rng = np.random.default_rng(3)
+    slices = [rng.integers(0, 1 << 20, size=(n, 3)).astype(np.int64)
+              for n in (17, 1, 63, 9)]
+    host = np.concatenate(slices, axis=0)
+
+    dj = DistributedWCOJExecutor.__new__(DistributedWCOJExecutor)
+    dj._settle_broken = False
+    monkeypatch.setattr(Global, "template_device", "device")
+    out = dj._settle(list(slices), 3)
+    assert out.dtype == np.int64
+    assert np.array_equal(out, host)
+    monkeypatch.setattr(Global, "template_device", "host")
+    out_h = dj._settle(list(slices), 3)
+    assert np.array_equal(out_h, host)

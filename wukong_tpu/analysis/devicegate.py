@@ -39,7 +39,12 @@ from wukong_tpu.analysis.framework import (
 )
 
 DEVICE_MODULE = "obs/device.py"
+TEMPLATE_MODULE = "engine/template_compile.py"
 INPUTS_NAME = "DEVICE_INPUTS"
+ROUTES_NAME = "TEMPLATE_ROUTES"
+READ_NAME = "read_device_input"
+KEY_FN = "_program_key"
+CHOOSER_FN = "choose_template_route"
 ALLOWLIST_NAME = "DEVICE_DISPATCH_ALLOWLIST"
 METRIC_PREFIX = "wukong_device_"
 SEAM_NAME = "maybe_device_dispatch"
@@ -115,6 +120,106 @@ class DeviceTelemetryGate(AnalysisPlugin):
         out.extend(self._check_dispatch_coverage(ctx, sf))
         out.extend(self._check_init_annotations(sf))
         out.extend(self._check_leaf_locks(sf))
+        out.extend(self._check_template_coherence(ctx, sf))
+        return out
+
+    # ------------------------------------------------------------------
+    # template coherence: the compiled-template actuator's contract
+    # ------------------------------------------------------------------
+    def _check_template_coherence(self, ctx: RepoContext,
+                                  dev_sf) -> list[Violation]:
+        """PR 19's actuator contract, AST-held: the whole-plan program
+        cache key composes the store version AND the route-knob set (a
+        knob flip or a write can never serve a stale compiled program);
+        the route registry is a literal dict; and every measured signal
+        the route chooser consumes arrives through ``read_device_input``
+        against a declared ``DEVICE_INPUTS`` member — never by reaching
+        into the observatory or the metrics registry directly."""
+        if TEMPLATE_MODULE not in ctx.paths():
+            return []  # no compiled-template plane: nothing to hold
+        sf = ctx.file(TEMPLATE_MODULE)
+        if sf.tree is None:
+            return []
+        out: list[Violation] = []
+        routes, rline = _literal_str_dict(sf, ROUTES_NAME)
+        if routes is None:
+            out.append(Violation(
+                self.name, TEMPLATE_MODULE, rline or 1,
+                f"no literal {ROUTES_NAME} dict found — every route a "
+                "template may take must be centrally enumerated with "
+                "what it means (the JOIN_ROUTES posture)"))
+        decl, _dl = _literal_str_dict(dev_sf, INPUTS_NAME)
+        for node in ast.walk(sf.tree):
+            if not (isinstance(node, ast.Call)
+                    and _call_name(node) == READ_NAME):
+                continue
+            s = _str_const(node.args[0]) if node.args else None
+            if s is None:
+                out.append(Violation(
+                    self.name, TEMPLATE_MODULE, node.lineno,
+                    f"{READ_NAME}() called with a non-literal signal — "
+                    "the route chooser's input surface must stay "
+                    "AST-verifiable against DEVICE_INPUTS"))
+            elif decl is not None and s not in decl:
+                out.append(Violation(
+                    self.name, TEMPLATE_MODULE, node.lineno,
+                    f"{READ_NAME}({s!r}) names a signal absent from "
+                    f"{DEVICE_MODULE}::{INPUTS_NAME} — the actuator may "
+                    "consume nothing the observatory does not declare"))
+        fns = {n.name: n for n in ast.walk(sf.tree)
+               if isinstance(n, ast.FunctionDef)}
+        pk = fns.get(KEY_FN)
+        if pk is None:
+            out.append(Violation(
+                self.name, TEMPLATE_MODULE, 1,
+                f"no {KEY_FN}() found — the compiled-program cache key "
+                "must be built in one provable place"))
+        else:
+            names = {n.id for n in ast.walk(pk)
+                     if isinstance(n, ast.Name)}
+            names |= {a.arg for a in pk.args.args}
+            calls = {_call_name(n) for n in ast.walk(pk)
+                     if isinstance(n, ast.Call)}
+            if "store_version" not in names:
+                out.append(Violation(
+                    self.name, TEMPLATE_MODULE, pk.lineno,
+                    f"{KEY_FN}() does not reference store_version — a "
+                    "dynamic insert must make every stale compiled "
+                    "program unreachable"))
+            if not any("knob" in c for c in calls):
+                out.append(Violation(
+                    self.name, TEMPLATE_MODULE, pk.lineno,
+                    f"{KEY_FN}() composes no route-knob set (no call "
+                    "naming the knobs) — a runtime knob flip could "
+                    "serve a program chosen under different routing "
+                    "rules"))
+        cr = fns.get(CHOOSER_FN)
+        if cr is None:
+            out.append(Violation(
+                self.name, TEMPLATE_MODULE, 1,
+                f"no {CHOOSER_FN}() found — the route decision must "
+                "live in one checkable function"))
+        else:
+            reads = [n for n in ast.walk(cr)
+                     if isinstance(n, ast.Call)
+                     and _call_name(n) == READ_NAME]
+            if not reads:
+                out.append(Violation(
+                    self.name, TEMPLATE_MODULE, cr.lineno,
+                    f"{CHOOSER_FN}() never calls {READ_NAME}() — "
+                    "measured-feedback demotion must consume declared "
+                    "device inputs, not folklore"))
+            direct = [n.lineno for n in ast.walk(cr)
+                      if (isinstance(n, ast.Name)
+                          and n.id == "_observatory")
+                      or (isinstance(n, ast.Call)
+                          and _call_name(n) == "get_registry")]
+            if direct:
+                out.append(Violation(
+                    self.name, TEMPLATE_MODULE, direct[0],
+                    f"{CHOOSER_FN}() reaches into the observatory or "
+                    f"metrics registry directly — all signal reads go "
+                    f"through {READ_NAME}()"))
         return out
 
     # ------------------------------------------------------------------

@@ -491,6 +491,33 @@ class GlobalConfig:
     # The measured-candidate feedback demotes templates that routed
     # device on an over-predicted estimate back to host.
     join_device_min_candidates: int = 65536
+    # whole-plan compiled template execution route (engine/
+    # template_compile.py): host (the NumPy walk engine), device (force
+    # the fused XLA program on every eligible template), auto (route
+    # device when the planner's estimated peak rows reach
+    # template_min_rows, with measured-feedback demotion reading only
+    # DEVICE_INPUTS). Any compile or mid-flight dispatch failure
+    # degrades the query to the host walk byte-identically and latches
+    # a per-template demotion.
+    template_device: str = "auto"
+    # dispatch-amortization floor: under `auto`, a template routes to
+    # the compiled program only when the planner's estimated peak
+    # intermediate rows reach this many (one fused dispatch costs ~ms;
+    # small plans are cheaper on the host walk)
+    template_min_rows: int = 4096
+    # capacity-overflow retries: a compiled run whose padded table
+    # overflows regrows its capacity classes (pad_pow2 of the measured
+    # totals) and re-dispatches at most this many times before
+    # degrading to the host walk
+    template_capacity_retries: int = 3
+    # byte budget for cached compiled-template programs and their
+    # staged CSR operand estimates; cold programs past it are
+    # LRU-evicted (charged on the residency ledger, kind "template")
+    template_budget_mb: int = 256
+    # measured-feedback demotion floor: a template whose observed
+    # padding efficiency (live rows / padded capacity, read from
+    # DEVICE_INPUTS) sits below this after warmup is demoted to host
+    template_demote_eff: float = 0.02
     # distributed generic join: max slice-range parts a cyclic query over
     # a sharded store fans out to on the heavy lane (hash-partitioning
     # the first eliminated variable); bounded by the shard count and the

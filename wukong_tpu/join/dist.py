@@ -349,8 +349,7 @@ class DistributedWCOJExecutor(WCOJExecutor):
             done = [s.carrier for s in slices
                     if s.error is None and s.carrier is not None]
             tables = [c.result.table for c in done]
-            prefix = (np.concatenate(tables) if tables
-                      else np.empty((0, len(qg.order)), dtype=np.int64))
+            prefix = self._settle(tables, len(qg.order), q)
             levels = (self._merge_levels([c.join_stats for c in done])
                       if done else [])
             self._commit(q, prefix, cols, levels, partial=True)
@@ -358,11 +357,65 @@ class DistributedWCOJExecutor(WCOJExecutor):
         # gather: slice tables are disjoint by the level-0 hash partition;
         # concatenation in slice order is the canonical gathered order
         tables = [s.carrier.result.table for s in slices]
-        prefix = (np.concatenate(tables) if tables
-                  else np.empty((0, len(qg.order)), dtype=np.int64))
+        prefix = self._settle(tables, len(qg.order), q)
         levels = self._merge_levels([s.carrier.join_stats for s in slices])
         self._commit(q, prefix, cols, levels, partial=False)
         q.join_dist = {"slices": S}
+
+    # ------------------------------------------------------------------
+    def _settle(self, tables: list, width: int, q=None) -> np.ndarray:
+        """Gather-barrier slice settlement (PR 19, consumer 1 of the
+        whole-plan compiled posture): the per-slice result tables
+        concatenate ON DEVICE through one fused dispatch
+        (join.kernels.jit_concat_rows) when the ``template_device`` knob
+        allows and the gathered volume amortizes it — byte-identical to
+        the host ``np.concatenate`` in slice order by the kernel parity
+        tests. Any device failure latches host for this executor and
+        settles on the host path."""
+        tables = [t for t in tables if t is not None]
+        if not tables:
+            return np.empty((0, width), dtype=np.int64)
+        knob = str(Global.template_device).strip().lower()
+        total = sum(len(t) for t in tables)
+        if (knob == "host" or len(tables) < 2 or width < 1 or total == 0
+                or getattr(self, "_settle_broken", False)
+                or (knob != "device"
+                    and total < max(int(Global.template_min_rows), 1))):
+            return np.concatenate(tables)
+        try:
+            from wukong_tpu.join.kernels import (
+                jit_concat_rows,
+                pad_pow2,
+                to_device_i32,
+            )
+            from wukong_tpu.obs.device import maybe_device_dispatch
+            from wukong_tpu.utils.timer import get_usec
+
+            S = len(tables)
+            cap = pad_pow2(max(len(t) for t in tables))
+            st = np.zeros((S, cap, width), dtype=np.int64)
+            counts = np.zeros(S, dtype=np.int64)
+            for i, t in enumerate(tables):
+                st[i, :len(t)] = t
+                counts[i] = len(t)
+            t0 = get_usec()
+            rows, valid, _tot = jit_concat_rows()(
+                to_device_i32(st), to_device_i32(counts))
+            out = np.asarray(rows)[np.asarray(valid)].astype(np.int64)
+            rec = maybe_device_dispatch(
+                "dist.settle", template=f"s{S}w{width}", live=total,
+                capacity=S * cap, wall_us=get_usec() - t0,
+                nbytes=int(st.nbytes // 2) + int(out.nbytes))
+            if rec is not None and q is not None:
+                dev = getattr(q, "device_steps", None)
+                if dev is None:
+                    dev = q.device_steps = []
+                dev.append(rec)
+            return out
+        except Exception as e:
+            self._settle_broken = True
+            log_warn(f"device slice settlement degraded to host: {e!r}")
+            return np.concatenate(tables)
 
     # ------------------------------------------------------------------
     def _run_slice(self, q, qg, unary, S: int, k: int) -> SPARQLQuery:

@@ -262,6 +262,159 @@ def seed_masks_host(s, p, o, tp, ts, to, eq) -> np.ndarray:
     return seed_masks(s, p, o, tp, ts, to, eq, xp=np)
 
 
+# ---------------------------------------------------------------------------
+# whole-plan compiled-template kernels (engine/template_compile.py)
+# ---------------------------------------------------------------------------
+
+def expand_padded(start, deg, edges, out_cap, xp=np):
+    """Order-preserving ragged expansion to a STATIC output capacity.
+
+    The padded twin of :func:`expand_ragged`: rows land in source-row
+    order with each row's edges contiguous (np.repeat order), so a
+    validity-compacted result is byte-identical to the host expansion —
+    the whole-plan program chains these without ever compacting on
+    device. Rows the caller masked out must arrive with ``deg == 0``
+    (their position range is then empty and they contribute nothing).
+
+    Returns ``(row_idx, values, valid, total, overflow)``: the source
+    row of each output slot, the gathered edge value, the live-slot
+    mask, the true output length, and an overflow flag. ``overflow``
+    also trips when the int32 cumulative sum wraps (a float32 shadow sum
+    of the degrees catches totals past 2^31 that the wrapped integer
+    comparison would miss) — the caller regrows the capacity class or
+    degrades to the host walk, never truncates.
+    """
+    n = int(start.shape[0])
+    ne = int(edges.shape[0])
+    cum = xp.cumsum(deg)
+    total = cum[n - 1]
+    pos = xp.arange(out_cap)
+    row = xp.searchsorted(cum, pos, side="right")
+    rowc = xp.clip(row, 0, n - 1)
+    prev = xp.where(rowc > 0, cum[xp.clip(rowc - 1, 0, n - 1)], 0)
+    local = pos - prev
+    if ne:
+        values = edges[xp.clip(start[rowc] + local, 0, ne - 1)]
+    else:
+        values = xp.zeros(out_cap, dtype=start.dtype)
+    valid = (pos < total) & (total > 0)
+    fsum = xp.sum(deg.astype(np.float32))
+    overflow = (total > out_cap) | (total < 0) | (fsum > float(out_cap))
+    return rowc, values, valid, total, overflow
+
+
+def unique_rows_padded(ca, cb, valid, xp=np):
+    """Padded two-column row dedupe matching ``np.unique(axis=0)`` order.
+
+    Live rows are lexsorted (first column primary), adjacent duplicates
+    are masked, and the survivors are stably compacted to the front —
+    the first ``count`` output rows equal the host oracle's unique rows
+    exactly, padding after them. A one-column dedupe passes the same
+    array as both columns. All shapes are static, so the same function
+    traces under jit and runs as the NumPy parity twin.
+    """
+    n = int(ca.shape[0])
+    order = xp.lexsort((cb, ca, ~valid))
+    a, b, v = ca[order], cb[order], valid[order]
+    first = xp.concatenate([xp.ones(1, dtype=bool),
+                            (a[1:] != a[:-1]) | (b[1:] != b[:-1])])
+    uniq = v & first
+    count = xp.sum(uniq.astype(np.int32))
+    comp = xp.lexsort((xp.arange(n), ~uniq))
+    return a[comp], b[comp], count
+
+
+def seed_extract_term(s, p, o, tp, ts, to, eq, ca, cb, xp=np):
+    """One semi-naive term's FUSED frontier eval: the seed_masks row mask
+    and the per-term unique seed rows in a single pass over the padded
+    epoch batch, replacing the host np.stack/np.unique partition pin
+    (stream/continuous.py). ``ca``/``cb`` select the term's seed columns
+    out of the stacked (s, p, o) triple columns (``ca == cb`` for a
+    one-variable term — the duplicated column dedupes identically to a
+    one-column np.unique). Returns ``(col_a, col_b, count)`` with the
+    first ``count`` rows live, in np.unique(axis=0) order."""
+    m = seed_masks(s, p, o, tp[None], ts[None], to[None], eq[None],
+                   xp=xp)[0]
+    cols = xp.stack([s, p, o])
+    return unique_rows_padded(cols[ca], cols[cb], m, xp=xp)
+
+
+def seed_extract_host(s, p, o, tp, ts, to, eq, ca, cb):
+    """NumPy twin of the fused per-term seed extraction (the parity
+    oracle): a Python loop over terms, each through the SAME
+    :func:`seed_extract_term` the device path traces."""
+    outs = [seed_extract_term(np.asarray(s), np.asarray(p), np.asarray(o),
+                              np.asarray(tp)[t], np.asarray(ts)[t],
+                              np.asarray(to)[t], np.asarray(eq)[t],
+                              int(ca[t]), int(cb[t]))
+            for t in range(len(tp))]
+    return (np.stack([a for a, _, _ in outs]),
+            np.stack([b for _, b, _ in outs]),
+            np.asarray([int(c) for _, _, c in outs]))
+
+
+_SEED_EXTRACT_FN = None
+
+
+def jit_seed_extract():
+    """jax.jit + vmap over terms of :func:`seed_extract_term` — one
+    compiled dispatch evaluates every term's frontier mask AND its
+    deduped seed rows for a whole epoch batch. N and T are padded to
+    capacity classes by the caller (pad_pow2), so large epochs share a
+    handful of compiles."""
+    global _SEED_EXTRACT_FN
+    if _SEED_EXTRACT_FN is not None:
+        return _SEED_EXTRACT_FN
+    import jax
+    import jax.numpy as jnp
+
+    def one(s, p, o, tp, ts, to, eq, ca, cb):
+        return seed_extract_term(s, p, o, tp, ts, to, eq, ca, cb, xp=jnp)
+
+    _SEED_EXTRACT_FN = jax.jit(
+        jax.vmap(one, in_axes=(None, None, None, 0, 0, 0, 0, 0, 0)))
+    return _SEED_EXTRACT_FN
+
+
+def concat_rows_padded(stacked, counts, xp=np):
+    """Device-side slice settlement: concatenate S padded row tables
+    ``stacked [S, cap, w]`` (each slice's first ``counts[i]`` rows live)
+    into one padded table in slice order — byte-identical to the host
+    ``np.concatenate`` over the live prefixes (join/dist.py's gather
+    barrier, which today settles on one host thread). Returns
+    ``(rows [S*cap, w], valid, total)``."""
+    S = int(stacked.shape[0])
+    cap = int(stacked.shape[1])
+    cum = xp.cumsum(counts)
+    total = cum[S - 1]
+    pos = xp.arange(S * cap)
+    sl = xp.searchsorted(cum, pos, side="right")
+    slc = xp.clip(sl, 0, S - 1)
+    prev = xp.where(slc > 0, cum[xp.clip(slc - 1, 0, S - 1)], 0)
+    local = xp.clip(pos - prev, 0, cap - 1)
+    rows = stacked[slc, local]
+    valid = pos < total
+    return rows, valid, total
+
+
+_CONCAT_ROWS_FN = None
+
+
+def jit_concat_rows():
+    """jax.jit-wrapped :func:`concat_rows_padded` (the settlement
+    dispatch). Slice count and capacity are padded by the caller so the
+    variant set stays bounded."""
+    global _CONCAT_ROWS_FN
+    if _CONCAT_ROWS_FN is not None:
+        return _CONCAT_ROWS_FN
+    import jax
+    import jax.numpy as jnp
+
+    _CONCAT_ROWS_FN = jax.jit(
+        lambda st, c: concat_rows_padded(st, c, xp=jnp))
+    return _CONCAT_ROWS_FN
+
+
 _SEED_MASK_FN = None
 
 

@@ -73,11 +73,12 @@ DEVICE_INPUTS = {
     "feedback": "wukong_device_feedback_total",
 }
 
-#: device-resident byte kinds the residency ledger totals (the three
-#: stores HBM_BUDGET.md budgets): join/wcoj.py JoinTableCache device
-#: tables, engine/device_store.py segment + index-list stagings, and
-#: vector/knn.py padded scan blocks
-RESIDENT_KINDS = ("join_table", "segment", "index", "knn")
+#: device-resident byte kinds the residency ledger totals (the stores
+#: HBM_BUDGET.md budgets): join/wcoj.py JoinTableCache device tables,
+#: engine/device_store.py segment + index-list stagings, vector/knn.py
+#: padded scan blocks, and engine/template_compile.py's cached
+#: whole-plan compiled programs with their staged operand estimates
+RESIDENT_KINDS = ("join_table", "segment", "index", "knn", "template")
 
 #: residency edge events counted per (kind, event)
 RESIDENCY_EVENTS = ("fill", "evict", "invalidate")
@@ -145,9 +146,10 @@ _M_RESIDENCY = get_registry().counter(
     labels=("kind", "event"))
 _M_COMPILE_CACHE = get_registry().counter(
     "wukong_device_compile_cache_total",
-    "Persistent XLA compile-cache setup outcomes "
-    "(utils/compilecache.py)",
-    labels=("outcome",))
+    "Persistent XLA compile-cache outcomes by site (utils/"
+    "compilecache.py boot setup; engine/template_compile.py "
+    "whole-plan program cache hits/misses/evictions)",
+    labels=("outcome", "site"))
 _M_FEEDBACK = get_registry().counter(
     "wukong_device_feedback_total",
     "Measured-feedback route decisions charged through the observatory "
@@ -593,11 +595,14 @@ def note_feedback(kind: str, reason: str) -> None:
     _M_FEEDBACK.labels(kind=kind, reason=reason).inc()
 
 
-def note_compile_cache(outcome: str) -> None:
-    """utils/compilecache.py reports persistent-cache setup here
-    (``available`` / ``unavailable``) instead of a bare log_warn — the
-    compile ledger's cold-dispatch amortization claim depends on it."""
-    _M_COMPILE_CACHE.labels(outcome=outcome).inc()
+def note_compile_cache(outcome: str, site: str = "boot") -> None:
+    """Compile-cache outcomes by site: utils/compilecache.py reports
+    persistent-cache setup (``available`` / ``unavailable``, site
+    ``boot``) and engine/template_compile.py charges its whole-plan
+    program cache (``hit`` / ``miss`` / ``evict``, site ``template``)
+    — a storm of whole-plan variants is visible to the same counter
+    the compile ledger's amortization claim reads."""
+    _M_COMPILE_CACHE.labels(outcome=outcome, site=site).inc()
 
 
 def read_device_input(signal: str, site: str | None = None):
@@ -696,6 +701,20 @@ def render_device(k: int | None = None) -> tuple[str, dict]:
         lines.append("VARIANTS  " + "  ".join(
             f"{s}:{n}" for s, n in sorted(rep["variants"].items()))
             + f"  (limit {Global.device_variant_limit}/window)")
+    # compiled-template demotion latches (engine/template_compile.py):
+    # a failed/losing whole-plan compile is diagnosable from /device
+    # without a trace dump. Lazy import — the observatory must render
+    # even when the engine package is not loaded.
+    try:
+        from wukong_tpu.engine.template_compile import demotion_report
+
+        demoted = demotion_report()
+    except Exception:
+        demoted = {}
+    if demoted:
+        js["template_demotions"] = dict(demoted)
+        lines.append("TEMPLATE  demoted  " + "  ".join(
+            f"{t[:16]}:{r}" for t, r in sorted(demoted.items())))
     lines.append(
         f"RESIDENT  total {res['total_bytes']:,}B  "
         f"high-water {res['high_water_bytes']:,}B  "

@@ -244,6 +244,11 @@ def _build_report(q, est: list | None, trace: QueryTrace | None,
         dist = getattr(q, "join_dist", None)
         if dist:
             report["join_dist"] = dist
+    elif getattr(q, "_template_compiled", False):
+        # the walk-strategy plan was served as ONE fused whole-plan XLA
+        # program (engine/template_compile.py) — its dispatch record
+        # rides the device table below like any other device step
+        report["route"] = "template-compiled"
     # hybrid graph+vector: the knn scan's planned shape (wukong_tpu/vector/)
     # — est rows = live embeddings the brute-force scan reads, est bytes =
     # their float32 block, route/mode as stamped by the proxy at plan time

@@ -466,6 +466,17 @@ class Planner:
         states = self._walk_chain(patterns)
         return None if states is None else [st.rows for st in states]
 
+    def estimate_peak_rows(self, patterns: list) -> int | None:
+        """Peak intermediate cardinality across an already-ordered chain,
+        or None when the shape cannot be walked. The compiled-template
+        route chooser gates on this: a whole-plan XLA dispatch only
+        amortizes when the binding tables it fuses are large enough
+        (``template_min_rows``) to beat the per-step host kernels."""
+        ests = self.estimate_chain(patterns)
+        if not ests:
+            return None
+        return int(max(ests))
+
     def explain_steps(self, patterns: list) -> list | None:
         """EXPLAIN estimate capture: one record per plan step with the
         estimated output cardinality and the cost model's per-step charge

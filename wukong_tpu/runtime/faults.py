@@ -88,6 +88,14 @@ KNOWN_FAULT_SITES = frozenset({
     "migration.clone",     # shard-migration snapshot (runtime/migration.py)
     "migration.catchup",   # shard-migration WAL-tail replay + dual-write
     "migration.cutover",   # shard-migration read-path swap
+    "template.compile",    # whole-plan program staging/trace
+                           # (engine/template_compile.py; fires before any
+                           # query state is touched, so an injected failure
+                           # degrades to the host walk byte-identically and
+                           # latches the per-template demotion)
+    "template.dispatch",   # whole-plan fused XLA dispatch (same contract:
+                           # the result commits only after a clean fetch,
+                           # so mid-flight chaos degrades, never corrupts)
 })
 
 

@@ -107,6 +107,17 @@ class Proxy:
         self._m_route_demoted = self.metrics.counter(
             "wukong_join_route_demotions_total",
             "Templates demoted device->host by measured-candidate feedback")
+        # compiled-template routing (engine/template_compile.py): plan-
+        # time route decisions and compiled executions degraded to the
+        # host walk (the demotion latch itself counts inside the engine)
+        self._m_template_route = self.metrics.counter(
+            "wukong_template_route_total",
+            "Plan-time compiled-template route decisions",
+            labels=("route",))
+        self._m_template_fallback = self.metrics.counter(
+            "wukong_template_fallback_total",
+            "Compiled-template executions degraded to the host walk",
+            labels=("reason",))
         # hybrid graph+vector serving (wukong_tpu/vector/): per-mode knn
         # query counts, plan-time scan-route decisions, and the measured
         # demotions back to the host kernels (the JOIN_ROUTES posture)
@@ -121,6 +132,7 @@ class Proxy:
             "knn templates demoted device->host by measured feedback")
         self._wcoj = None  # guarded by: _batcher_init_lock
         self._wcoj_dist = None  # guarded by: _batcher_init_lock
+        self._template = None  # guarded by: _batcher_init_lock
         self._pool = None
         self._stream = None
         # serving fast path: parse cache (query text -> parsed query) and
@@ -543,6 +555,11 @@ class Proxy:
         if qq.join_strategy == "wcoj":
             qq.join_route = self.classify_join_route(qq)
             self._m_join_route.labels(route=qq.join_route).inc()
+        elif getattr(qq, "knn", None) is None:
+            # walk-strategy shapes may compile the WHOLE plan into one
+            # fused device program (engine/template_compile.py)
+            qq.template_route = self.classify_template_route(qq)
+            self._m_template_route.labels(route=qq.template_route).inc()
 
     # ------------------------------------------------------------------
     # hybrid graph+vector routing (wukong_tpu/vector/)
@@ -759,6 +776,82 @@ class Proxy:
                      f"join_device_min_candidates "
                      f"{Global.join_device_min_candidates:,})")
 
+    # ------------------------------------------------------------------
+    # whole-plan compiled-template routing (engine/template_compile.py)
+    # ------------------------------------------------------------------
+    def classify_template_route(self, q: SPARQLQuery) -> str:
+        """Plan-time host/device route for a walk-strategy query through
+        the whole-plan compiled engine. Only the planner's peak-rows
+        ESTIMATE is memoized (per template signature + store version,
+        the ``lane`` pattern) — the route itself is chosen live by
+        ``choose_template_route`` so the per-template demotion latch and
+        the measured padding-efficiency feedback apply on the very next
+        query, not at the next memo invalidation."""
+        from wukong_tpu.engine.template_compile import \
+            choose_template_route
+
+        # the PRE-PLAN signature (stamped in _plan): the demotion latch
+        # keys on q._tsig at failure time, and the planner has reordered
+        # the patterns by now — recomputing here would never match it
+        sig = getattr(q, "_tsig", None)
+        if sig is None:
+            sig = template_signature(q)
+        if sig is None:
+            return "host"  # recursive shapes: no template to compile
+        est = None
+        if self.planner is not None and Global.enable_planner:
+            pats = list(q.pattern_group.patterns)
+
+            def compute():
+                try:
+                    return self.planner.estimate_peak_rows(pats)
+                except Exception:
+                    return None
+
+            est = self._plan_cache.aux("template_est", sig,
+                                       self._plan_version(), compute)
+        q._template_est_rows = est
+        return choose_template_route(sig, est,
+                                     getattr(self.g, "version", 0))
+
+    def template_engine(self):
+        """Lazily-built whole-plan compiled engine over the host
+        partition (its staged device operands are cached per store
+        version through the shared JoinTableCache discipline, so
+        dynamic inserts and stream commits self-invalidate)."""
+        if self._template is None:  # unguarded: double-checked fast path, as wcoj()
+            with self._batcher_init_lock:
+                if self._template is None:
+                    from wukong_tpu.engine.template_compile import \
+                        TemplateCompiledEngine
+
+                    self._template = TemplateCompiledEngine(
+                        self.g, self.str_server)
+        return self._template  # unguarded: write-once reference, non-None past init
+
+    def _record_template_feedback(self, q: SPARQLQuery) -> None:
+        """Measured feedback for the compiled-template route: after a
+        successful compiled execution under ``template_device auto``, a
+        measured live-row count below ``template_min_rows`` means the
+        estimate over-predicted and the fused dispatch was overhead on a
+        plan this small — latch the template back to the host walk (a
+        store mutation re-arms the estimate-driven decision)."""
+        if str(Global.template_device).strip().lower() != "auto":
+            return
+        recs = [r for r in (getattr(q, "device_steps", None) or [])
+                if r.get("site") == "template.plan"]
+        if not recs:
+            return
+        live = int(recs[-1].get("live", 0))
+        if live < max(int(Global.template_min_rows), 1):
+            from wukong_tpu.engine.template_compile import latch_demotion
+
+            latch_demotion(getattr(q, "_tsig", None), "small_measured",
+                           getattr(self.g, "version", 0))
+            log_info(f"compiled template demoted to the host walk "
+                     f"(measured live rows {live:,} < template_min_rows "
+                     f"{Global.template_min_rows:,})")
+
     def _record_wcoj_feedback(self, q: SPARQLQuery) -> None:
         """WCOJ auto-routing feedback (PR 9 headroom): after a successful
         wcoj execution, record the MEASURED materialized-prefix blowup
@@ -970,6 +1063,33 @@ class Proxy:
                     if tr is not None:
                         tr.event("join.fallback", reason=reason)
                     log_info(f"wcoj degraded to the walk ({reason})")
+            if getattr(q, "template_route", "host") == "device" \
+                    and not pinned and eng is not self.dist \
+                    and getattr(q, "knn", None) is None:
+                # whole-plan compiled execution: one fused XLA dispatch
+                # serves the query byte-identically, or the plan shape
+                # is refused (False) and the walk below owns it; any
+                # compile/dispatch FAILURE latches a per-template
+                # demotion so same-template queries stop re-paying the
+                # failed device attempt until a store mutation re-arms
+                try:
+                    if self.template_engine().try_execute(q):
+                        self._record_template_feedback(q)
+                        return q
+                except Exception as e:
+                    from wukong_tpu.engine.template_compile import \
+                        latch_demotion
+
+                    reason = (e.code.name if isinstance(e, WukongError)
+                              else type(e).__name__)
+                    latch_demotion(getattr(q, "_tsig", None), reason,
+                                   getattr(self.g, "version", 0))
+                    self._m_template_fallback.labels(reason=reason).inc()
+                    tr = getattr(q, "trace", None)
+                    if tr is not None:
+                        tr.event("template.fallback", reason=reason)
+                    log_info(f"compiled template degraded to the walk "
+                             f"({reason})")
             if Global.enable_batching and not pinned and eng is not None \
                     and eng is not self.dist \
                     and getattr(q, "knn", None) is None:

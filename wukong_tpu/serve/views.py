@@ -188,6 +188,22 @@ class ViewRegistry:
         with self._lock:
             if not self._views or self._ce is None:
                 return survivors
+            # epoch-batched device frontier (PR 19, consumer 3 of the
+            # whole-plan compiled posture): EVERY view's per-term seed
+            # extraction for this edge fuses into one device dispatch;
+            # None (host knob / small epoch / a latched failure) keeps
+            # the per-term host path, byte-identical by construction
+            from wukong_tpu.stream.continuous import device_seed_extract
+
+            flat: list = []
+            spans: dict = {}
+            for material, view in self._views.items():
+                sq = self._ce.queries.get(view.qid)
+                if sq is None:
+                    continue
+                spans[material] = (len(flat), len(flat) + len(sq.patterns))
+                flat.extend(sq.patterns)
+            all_seeds = device_seed_extract(flat, triples, owner=self)
             demote = []
             for material, view in self._views.items():
                 sq = self._ce.queries.get(view.qid)
@@ -195,7 +211,11 @@ class ViewRegistry:
                     demote.append(material)
                     continue
                 view.edges_seen += 1
-                touched = self._derives_rows(sq, triples, version)
+                lo, hi = spans.get(material, (0, 0))
+                touched = self._derives_rows(
+                    sq, triples, version,
+                    seeds=(all_seeds[lo:hi] if all_seeds is not None
+                           else None))
                 if touched:
                     view.touched += 1
                     _M_VIEWS.labels(event="touched").inc()
@@ -213,17 +233,24 @@ class ViewRegistry:
                 self._demote_locked(material)
         return survivors
 
-    def _derives_rows(self, sq, triples, version: int) -> bool:  # caller holds: _lock
+    def _derives_rows(self, sq, triples, version: int,  # caller holds: _lock
+                      seeds=None) -> bool:
         """The semi-naive term union, counting DERIVED rows (duplicates
         included): True when the batch contributes >=1 complete
         derivation — the reply bytes changed. Term failures are
-        conservative touches (degraded, never a stale hit)."""
+        conservative touches (degraded, never a stale hit). ``seeds``
+        carries this view's slice of the epoch-batched device frontier
+        (on_mutation's single fused dispatch); None runs the per-term
+        host extraction."""
         from wukong_tpu.stream.continuous import match_delta
         from wukong_tpu.utils.errors import ErrorCode
 
         derived = set()
         for i, pat in enumerate(sq.patterns):
-            vars_, seed = match_delta(pat, triples)
+            if seeds is not None:
+                vars_, seed = seeds[i]
+            else:
+                vars_, seed = match_delta(pat, triples)
             if len(seed) == 0:
                 continue
             q = self._ce._make_delta_query(sq, i, vars_, seed)

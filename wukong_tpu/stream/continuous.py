@@ -198,6 +198,111 @@ def device_seed_masks(patterns: list, triples: np.ndarray, owner=None):
         return None
 
 
+def _term_var_cols(pat: Pattern) -> tuple[list[int], int, int]:
+    """A term's variable endpoints in match_delta's triple order, plus
+    the stacked-(s, p, o) column each seed column draws from (``ca ==
+    cb`` for a one-variable term — the duplicated column dedupes
+    identically to a one-column np.unique)."""
+    ts, _tp, to = _triplewise(pat)
+    vars_: list[int] = []
+    cols: list[int] = []
+    for end, c in ((ts, 0), (to, 2)):
+        if end < 0 and end not in vars_:
+            vars_.append(end)
+            cols.append(c)
+    if not cols:
+        return vars_, 0, 0
+    if len(cols) == 1:
+        return vars_, cols[0], cols[0]
+    return vars_, cols[0], cols[1]
+
+
+def device_seed_extract(patterns: list, triples: np.ndarray, owner=None):
+    """FULLY device-evaluated stream frontier (PR 19, consumer 2 of the
+    whole-plan compiled posture): one fused XLA call evaluates every
+    term's row mask AND its deduped seed rows
+    (join.kernels.jit_seed_extract), dropping the per-term host
+    ``np.stack``/``np.unique`` partition pin that ``device_seed_masks``
+    still paid after its mask dispatch. Returns ``[(vars, seed)]`` in
+    term order — byte-identical to :func:`match_delta` per the kernel
+    parity tests — or None when the ``template_device`` knob pins host,
+    the epoch is under the amortization threshold, or the device path
+    failed (latched per engine on ``owner``, the
+    ``_seed_device_broken`` posture)."""
+    knob = str(Global.template_device).strip().lower()
+    n = len(triples)
+    if (knob == "host" or not patterns or n == 0
+            or (owner is not None
+                and getattr(owner, "_seed_extract_broken", False))
+            or (knob != "device"
+                and n * len(patterns)
+                < max(int(Global.join_device_min_candidates), 1))):
+        return None
+    try:
+        from wukong_tpu.join.kernels import (
+            jit_seed_extract,
+            pad_pow2,
+            to_device_i32,
+        )
+
+        T = len(patterns)
+        tp = np.empty(T, dtype=np.int32)
+        ts = np.empty(T, dtype=np.int32)
+        to = np.empty(T, dtype=np.int32)
+        eq = np.zeros(T, dtype=bool)
+        ca = np.zeros(T, dtype=np.int32)
+        cb = np.zeros(T, dtype=np.int32)
+        metas: list[list[int]] = []
+        for i, pat in enumerate(patterns):
+            ps, pp, po = _triplewise(pat)
+            tp[i] = pp
+            ts[i] = ps if ps >= 0 else -1
+            to[i] = po if po >= 0 else -1
+            eq[i] = ps < 0 and ps == po
+            vars_, a, b = _term_var_cols(pat)
+            ca[i], cb[i] = a, b
+            metas.append(vars_)
+        npad = pad_pow2(n)
+        s = np.full(npad, -1, dtype=np.int64)
+        p = np.full(npad, -1, dtype=np.int64)
+        o = np.full(npad, -1, dtype=np.int64)
+        s[:n], p[:n], o[:n] = triples[:, 0], triples[:, 1], triples[:, 2]
+        fn = jit_seed_extract()
+        t0 = get_usec()
+        A, B, counts = fn(
+            to_device_i32(s), to_device_i32(p), to_device_i32(o),
+            to_device_i32(tp), to_device_i32(ts), to_device_i32(to),
+            np.asarray(eq), to_device_i32(ca), to_device_i32(cb))
+        A, B = np.asarray(A), np.asarray(B)  # blocking D2H sync
+        counts = np.asarray(counts)
+        _M_SEED_BATCH.labels(outcome="fused").inc()
+        from wukong_tpu.obs.device import maybe_device_dispatch
+
+        maybe_device_dispatch(
+            "stream.seed_extract", template=f"t{T}",
+            live=int(counts.sum()), capacity=npad * T,
+            wall_us=get_usec() - t0,
+            nbytes=3 * 4 * npad + 5 * 4 * T + 2 * 4 * T * npad)
+        out = []
+        for i, vars_ in enumerate(metas):
+            k = int(counts[i])
+            if not vars_:
+                out.append((vars_, np.empty((0, 0), dtype=np.int64)))
+            elif len(vars_) == 1:
+                out.append((vars_,
+                            A[i, :k].astype(np.int64).reshape(-1, 1)))
+            else:
+                out.append((vars_, np.stack(
+                    [A[i, :k], B[i, :k]], axis=1).astype(np.int64)))
+        return out
+    except Exception as e:
+        _M_SEED_BATCH.labels(outcome="fallback").inc()
+        if owner is not None:
+            owner._seed_extract_broken = True
+        log_warn(f"fused device seed extraction degraded to host: {e!r}")
+        return None
+
+
 def _pattern_vars(patterns: list[Pattern]) -> set[int]:
     return {v for p in patterns for v in (p.subject, p.object) if v < 0}
 
@@ -528,11 +633,19 @@ class ContinuousEngine:
         new_rows: set = set()
         degraded = False
         jobs = []  # (query, term index)
-        masks = device_seed_masks(sq.patterns, triples, owner=self)
+        # fused frontier first (mask + unique seed rows in ONE device
+        # call); the mask-only batch and the per-term host masks remain
+        # the byte-identical fallbacks, in that order
+        seeds = device_seed_extract(sq.patterns, triples, owner=self)
+        masks = (None if seeds is not None
+                 else device_seed_masks(sq.patterns, triples, owner=self))
         for i, pat in enumerate(sq.patterns):
-            vars_, seed = match_delta(
-                pat, triples,
-                row_mask=masks[i] if masks is not None else None)
+            if seeds is not None:
+                vars_, seed = seeds[i]
+            else:
+                vars_, seed = match_delta(
+                    pat, triples,
+                    row_mask=masks[i] if masks is not None else None)
             if len(seed) == 0:
                 continue
             q = self._make_delta_query(sq, i, vars_, seed)
@@ -742,11 +855,16 @@ class ContinuousEngine:
         Raises on any term failure — the caller falls back to a full
         refresh rather than trusting an incomplete candidate set."""
         rows: set = set()
-        masks = device_seed_masks(sq.patterns, triples, owner=self)
+        seeds = device_seed_extract(sq.patterns, triples, owner=self)
+        masks = (None if seeds is not None
+                 else device_seed_masks(sq.patterns, triples, owner=self))
         for i, pat in enumerate(sq.patterns):
-            vars_, seed = match_delta(
-                pat, triples,
-                row_mask=masks[i] if masks is not None else None)
+            if seeds is not None:
+                vars_, seed = seeds[i]
+            else:
+                vars_, seed = match_delta(
+                    pat, triples,
+                    row_mask=masks[i] if masks is not None else None)
             if len(seed) == 0:
                 continue
             q = self._make_delta_query(sq, i, vars_, seed)

@@ -29,11 +29,13 @@ _logged_dir: str | None = None
 
 def _note(outcome: str) -> None:
     """Charge the setup outcome on the device observatory's compile-cache
-    counter; tolerate a broken obs import (this runs at process boot)."""
+    counter (site ``boot`` — engine/template_compile.py charges the same
+    counter under site ``template``); tolerate a broken obs import (this
+    runs at process boot)."""
     try:
         from wukong_tpu.obs.device import note_compile_cache
 
-        note_compile_cache(outcome)
+        note_compile_cache(outcome, site="boot")
     except Exception:
         pass
 
