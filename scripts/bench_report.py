@@ -131,6 +131,15 @@ def _headline(d: dict) -> dict | None:
     if isinstance(d.get("padding_efficiency"), (int, float)):
         return {"value": float(d["padding_efficiency"]), "unit": "ratio",
                 "metric": "padding_efficiency"}
+    # multi-process rung: serving qps over the worker pool's framed
+    # socket transport (BENCH_PROC.json; the drill self-gates on
+    # byte-identity with loopback and on landing within 2x of the
+    # same-run in-proc number, so it is trended but never
+    # threshold-checked here). Before the generic value branch so the
+    # series keeps the short name instead of the long metric sentence
+    if isinstance(d.get("proc_qps"), (int, float)):
+        return {"value": float(d["proc_qps"]), "unit": "q/s",
+                "metric": "proc_qps"}
     if isinstance(d.get("value"), (int, float)):
         return {"value": float(d["value"]), "unit": d.get("unit", ""),
                 "metric": str(d.get("metric", ""))[:160]}

@@ -66,6 +66,15 @@ echo "== tenant admission drill (2x-capacity overload ladder) =="
 # (exits non-zero otherwise; see tenants_main gates)
 JAX_PLATFORMS=cpu python bench.py --tenants
 
+echo "== multi-process rung (worker pool vs in-proc loopback) =="
+# the same distributed world served over the in-proc loopback transport
+# and then over the live worker pool (process-per-shard-group, framed +
+# CRC socket wire, stagings invalidated every round): every socket
+# reply must be byte-identical to its loopback twin, loopback must come
+# back untouched after stop(), and the pool's qps must land within 2x
+# of the in-proc number (exits non-zero otherwise; see proc_main gates)
+JAX_PLATFORMS=cpu python bench.py --proc
+
 echo "== graphrag hybrid drill (k-NN route + vectors-off zero-touch) =="
 # the hybrid graph+vector serving loop: pure-scan device route must
 # clear 3x host on the >=100k x 128d block OR the measured-demotion

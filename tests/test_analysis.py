@@ -50,7 +50,8 @@ def test_plugin_registry():
         "fault-sites", "config-readme", "metrics-readme", "error-taxonomy",
         "heat-telemetry", "join-strategy", "slo-telemetry",
         "placement-telemetry", "migration-safety", "cache-coherence",
-        "admission-contract", "vector-coherence", "device-telemetry"}
+        "admission-contract", "vector-coherence", "device-telemetry",
+        "transport-contract"}
 
 
 def test_unknown_plugin_rejected():

@@ -91,6 +91,12 @@ CACHE_ALLOWLIST = {
     # migration catch-up replays onto the NOT-yet-serving recipient; the
     # cutover that publishes it notes the "cutover" purge
     ("runtime/migration.py", "_phase_catchup"),
+    # worker-process replay targets the worker's own partition copies in a
+    # CHILD process — the parent's serving caches are not in that address
+    # space; the parent-side mutation that produced each record already
+    # noted its own invalidation
+    ("runtime/procs.py", "worker_main"),
+    ("runtime/procs.py", "sync"),
 }
 
 

@@ -199,6 +199,7 @@ def _load_builtin_plugins() -> None:
         placegate,
         slogate,
         telemetry,
+        transportgate,
         vectorgate,
     )
 

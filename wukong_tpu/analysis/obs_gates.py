@@ -74,6 +74,11 @@ WAL_ALLOWLIST = {
     # migration catch-up replays the durable tail onto the not-yet-serving
     # recipient under the mutation lock + WAL suppression
     ("runtime/migration.py", "_phase_catchup"),
+    # worker processes replay the parent's already-durable WAL records
+    # read-only into their own (non-authoritative) partition copies —
+    # re-appending them would double-log every mutation
+    ("runtime/procs.py", "worker_main"),
+    ("runtime/procs.py", "sync"),
 }
 
 

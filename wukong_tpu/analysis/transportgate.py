@@ -1,0 +1,204 @@
+"""transport-contract gate: the wire protocol's message surface stays
+closed, two-sided, and exercised.
+
+The transport seam (runtime/transport.py) turns shard fetches into named
+wire messages. A message type is four artifacts that must agree — a
+``MESSAGE_REGISTRY`` entry (serialize + deserialize pair), an
+``OP_HANDLERS`` executor, at least one call site naming the op, and a
+test exercising it — and nothing but convention keeps them together: an
+op added at a call site without a registry row fails only at runtime on
+the socket path (which CI barely exercises), and a registry row nobody
+calls or tests is dead protocol surface that rots silently. This gate
+holds all four mechanically:
+
+- ``MESSAGE_REGISTRY`` and ``OP_HANDLERS`` are literal dicts in
+  runtime/transport.py with string keys; every registry value is a
+  2-tuple ``(pack_x, unpack_x)`` of module-level functions that exist
+  (both sides of every message type), every handler value likewise.
+- the two key sets are identical — a message the client can send but the
+  server cannot execute (or vice versa) is a protocol hole.
+- every op named at a call site (``run_op(op, ...)``, ``.call(addr, op,
+  ...)``, ``._retry_call(shard, op, ...)``, ``.fetch(i, store, op, ...)``,
+  or the ``(op, args)`` tuple handed to ``_fetch_shard``) is declared in
+  the registry, and every declared op is named by at least one call site
+  in the package — both directions.
+- every declared op appears (quoted) in tests/ — an untested message
+  type's serialize/deserialize pair is unverified protocol.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from wukong_tpu.analysis.framework import (
+    AnalysisPlugin,
+    RepoContext,
+    Violation,
+    register,
+)
+
+TRANSPORT_MODULE = "runtime/transport.py"
+REGISTRY_NAME = "MESSAGE_REGISTRY"
+HANDLERS_NAME = "OP_HANDLERS"
+
+#: call shapes that name a wire op, and the argument position the op
+#: string occupies in each: run_op(op, g, *a) / transport.call(addr, op,
+#: sid, a) / transport._retry_call(shard, op, a) / transport.fetch(i,
+#: store, op, a) / sstore._fetch_shard(i, (op, args), what)
+_OP_ARG_POS = {"run_op": 0, "call": 1, "_retry_call": 1, "fetch": 2,
+               "_fetch_shard": 1}
+
+
+def _str_const(node) -> str | None:
+    return node.value if (isinstance(node, ast.Constant)
+                          and isinstance(node.value, str)) else None
+
+
+def _call_name(node: ast.Call) -> str:
+    fn = node.func
+    return fn.id if isinstance(fn, ast.Name) else (
+        fn.attr if isinstance(fn, ast.Attribute) else "")
+
+
+@register
+class TransportContractGate(AnalysisPlugin):
+    name = "transport-contract"
+    description = ("MESSAGE_REGISTRY/OP_HANDLERS literal + two-sided + "
+                   "identical key sets; every op used <-> declared <-> "
+                   "tested")
+
+    # ------------------------------------------------------------------
+    def run(self, ctx: RepoContext) -> list[Violation]:
+        if TRANSPORT_MODULE not in ctx.paths():
+            return []  # tree without a transport seam: nothing to check
+        out: list[Violation] = []
+        sf = ctx.file(TRANSPORT_MODULE)
+        registry, rline = self._literal_dict(sf, REGISTRY_NAME)
+        handlers, hline = self._literal_dict(sf, HANDLERS_NAME)
+        if registry is None:
+            out.append(Violation(
+                self.name, TRANSPORT_MODULE, rline or 1,
+                f"no literal {REGISTRY_NAME} dict found — every wire "
+                "message type must be centrally declared with its "
+                "serialize+deserialize pair"))
+        if handlers is None:
+            out.append(Violation(
+                self.name, TRANSPORT_MODULE, hline or 1,
+                f"no literal {HANDLERS_NAME} dict found — every wire "
+                "message type needs a declared server-side executor"))
+        if registry is None or handlers is None:
+            return out
+        funcs = {n.name: n.lineno for n in sf.tree.body
+                 if isinstance(n, ast.FunctionDef)}
+        out.extend(self._check_two_sided(registry, funcs, rline))
+        out.extend(self._check_handlers(handlers, funcs, hline))
+        if set(registry) != set(handlers):
+            only_r = sorted(set(registry) - set(handlers))
+            only_h = sorted(set(handlers) - set(registry))
+            out.append(Violation(
+                self.name, TRANSPORT_MODULE, rline,
+                f"{REGISTRY_NAME} and {HANDLERS_NAME} key sets differ "
+                f"(registry-only: {only_r}, handlers-only: {only_h}) — a "
+                "message one side speaks and the other cannot is a "
+                "protocol hole"))
+        used = self._used_ops(ctx)
+        for op, (rel, line) in sorted(used.items()):
+            if op not in registry:
+                out.append(Violation(
+                    self.name, rel, line,
+                    f"call site names wire op {op!r} but {REGISTRY_NAME} "
+                    "does not declare it — undeclared ops fail only at "
+                    "runtime on the socket path"))
+        tests = ctx.tests_text() or ""
+        for op in sorted(registry):
+            if op not in used:
+                out.append(Violation(
+                    self.name, TRANSPORT_MODULE, rline,
+                    f"wire op {op!r} is declared but no call site in the "
+                    "package names it — dead protocol surface"))
+            if f'"{op}"' not in tests and f"'{op}'" not in tests:
+                out.append(Violation(
+                    self.name, TRANSPORT_MODULE, rline,
+                    f"wire op {op!r} is never exercised by tests/ — an "
+                    "untested message type's serialize/deserialize pair "
+                    "is unverified protocol"))
+        return out
+
+    # ------------------------------------------------------------------
+    def _literal_dict(self, sf, name: str):
+        """(key -> value ast node, lineno) of a literal top-level dict
+        assignment; (None, lineno) when missing or non-literal."""
+        if sf.tree is None:
+            return None, 0
+        for st in sf.tree.body:
+            tgt = st.targets[0] if isinstance(st, ast.Assign) else (
+                st.target if isinstance(st, ast.AnnAssign) else None)
+            if not (isinstance(tgt, ast.Name) and tgt.id == name):
+                continue
+            val = st.value
+            if not isinstance(val, ast.Dict):
+                return None, st.lineno
+            decl = {}
+            for k, v in zip(val.keys, val.values):
+                ks = _str_const(k)
+                if ks is None:
+                    return None, st.lineno  # non-literal key: unverifiable
+                decl[ks] = v
+            return decl, st.lineno
+        return None, 0
+
+    def _check_two_sided(self, registry: dict, funcs: dict,
+                         line: int) -> list[Violation]:
+        out = []
+        for op, val in sorted(registry.items()):
+            names = ([e.id for e in val.elts if isinstance(e, ast.Name)]
+                     if isinstance(val, ast.Tuple) else [])
+            if not isinstance(val, ast.Tuple) or len(val.elts) != 2 \
+                    or len(names) != 2:
+                out.append(Violation(
+                    self.name, TRANSPORT_MODULE, getattr(val, "lineno", line),
+                    f"{REGISTRY_NAME}[{op!r}] must be a literal 2-tuple of "
+                    "module-level function names (serialize, deserialize)"))
+                continue
+            for side, fname in zip(("serialize", "deserialize"), names):
+                if fname not in funcs:
+                    out.append(Violation(
+                        self.name, TRANSPORT_MODULE, val.lineno,
+                        f"{REGISTRY_NAME}[{op!r}] {side} side {fname!r} is "
+                        "not a module-level function in "
+                        f"{TRANSPORT_MODULE}"))
+        return out
+
+    def _check_handlers(self, handlers: dict, funcs: dict,
+                        line: int) -> list[Violation]:
+        out = []
+        for op, val in sorted(handlers.items()):
+            if not (isinstance(val, ast.Name) and val.id in funcs):
+                out.append(Violation(
+                    self.name, TRANSPORT_MODULE, getattr(val, "lineno", line),
+                    f"{HANDLERS_NAME}[{op!r}] must name a module-level "
+                    f"executor function in {TRANSPORT_MODULE}"))
+        return out
+
+    # ------------------------------------------------------------------
+    def _used_ops(self, ctx: RepoContext) -> dict[str, tuple]:
+        """op -> (rel, lineno) for every call site naming a wire op, at
+        the exact argument position each call shape carries it."""
+        used: dict[str, tuple] = {}
+        for sf in ctx.iter_files():
+            if sf.tree is None:
+                continue
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                pos = _OP_ARG_POS.get(_call_name(node))
+                if pos is None or len(node.args) <= pos:
+                    continue
+                arg = node.args[pos]
+                s = _str_const(arg)
+                if s is None and isinstance(arg, ast.Tuple) and arg.elts:
+                    # the _fetch_shard shape: fn is an (op, args) tuple
+                    s = _str_const(arg.elts[0])
+                if s is not None:
+                    used.setdefault(s, (sf.rel, node.lineno))
+        return used

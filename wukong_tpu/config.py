@@ -109,6 +109,29 @@ class GlobalConfig:
     # checkpointer cadence (0 = manual `checkpoint` console verb only)
     checkpoint_dir: str = ""
     checkpoint_interval_s: int = 0
+    # ---- multi-process data plane (runtime/transport.py + procs.py) ----
+    # transport seam for shard fetches / migration transfers: "loopback"
+    # executes ops in-process against the local store (byte-for-byte the
+    # single-process behavior, zero serialization); "socket" arms the
+    # framed TCP wire path whose peers the process supervisor registers.
+    transport_mode: str = "loopback"
+    # per-connection send/recv and connect timeouts for the socket
+    # transport; a timeout surfaces as TransientFault → retry_call →
+    # breaker, never a hung query
+    transport_timeout_ms: int = 2000
+    transport_connect_timeout_ms: int = 1000
+    # hard ceiling on one wire frame, enforced on BOTH encode and decode
+    # (oversized payloads raise FRAME_TOO_LARGE naming this knob)
+    transport_max_frame_mb: int = 64
+    # process supervision: worker processes per parent (shards are split
+    # into contiguous groups), heartbeat cadence and the consecutive-miss
+    # threshold that declares a worker dead, and the capped-exponential
+    # restart backoff (base * 2^n, clamped to the max)
+    proc_workers: int = 2
+    proc_heartbeat_ms: int = 500
+    proc_heartbeat_misses: int = 3
+    proc_restart_backoff_ms: int = 100
+    proc_restart_backoff_max_ms: int = 5000
 
     # ---- observability knobs (wukong_tpu/obs/; all mutable) ----
     # per-query tracing (trace id + span stack, proxy->engine->shard-fetch).

@@ -479,9 +479,12 @@ class DistEngine:
             def _dispatch():
                 # transient dispatch failures (device hiccup, injected
                 # chaos) retry with backoff; inputs are immutable so a
-                # re-dispatch is safe
+                # re-dispatch is safe. Routed through the transport seam:
+                # the mesh is process-local on every backend we have, so
+                # both transports execute in place, but the dispatch path
+                # shares the fetch path's boundary object by contract.
                 faults.site("dist.chain_dispatch")
-                return fn(*args)
+                return self.sstore.transport.dispatch(fn, *args)
 
             out = retry_call(_dispatch, site="dist.chain_dispatch",
                              retry_on=(faults.TransientFault,),

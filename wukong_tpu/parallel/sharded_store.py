@@ -16,7 +16,7 @@ import numpy as np
 
 from wukong_tpu.analysis.lockdep import declare_leaf, make_lock
 from wukong_tpu.engine.device_store import _next_pow2, build_hash_table
-from wukong_tpu.types import IN, TYPE_ID
+from wukong_tpu.runtime.transport import make_transport, run_op
 
 INT32_MAX = np.iinfo(np.int32).max
 
@@ -53,6 +53,16 @@ class StackedIndex:
     edges: object  # [D, L_pad] sharded on axis 0; pad INT32_MAX
     real_lens: np.ndarray  # [D] host-side true lengths
     total: int
+
+
+def _exec_local(fn, g):
+    """Run a fetch spec against a parent-local store (replica/rotation
+    copies): declared ``(op, args)`` tuples through run_op, closures
+    directly. Never touches the transport — these copies exist to answer
+    when the remote side is gone."""
+    if isinstance(fn, tuple):
+        return run_op(fn[0], g, *fn[1])
+    return fn(g)
 
 
 class ShardedDeviceStore:
@@ -108,6 +118,13 @@ class ShardedDeviceStore:
         self.placement: dict[int, int] = {}  # lock-free: reads are atomic dict gets on the fetch path; writes publish under _migration_lock (cutover/rollback)
         self.rotation: dict[int, list] = {}  # lock-free: fetch-path reads see the old or new list, never torn; writes publish under _migration_lock
         self._rotation_rr: dict[int, int] = {}  # lock-free: racy int bumps only skew the read split by one turn
+        # the data plane's remote boundary (runtime/transport.py): named
+        # ops route primary fetches through it (loopback executes against
+        # the local store — byte-for-byte the single-process behavior; the
+        # socket transport sends them to worker processes). Replica and
+        # rotation fetches stay parent-local by design: they exist to
+        # answer when the remote side is GONE.
+        self.transport = make_transport()  # lock-free: whole-reference swap by the supervisor; fetches read it once per attempt
         if self.replication_factor > 1:
             self.refresh_replicas()
 
@@ -278,8 +295,13 @@ class ShardedDeviceStore:
         """One shard's host-side fetch through the resilience layer: the
         ``dist.shard_fetch`` fault site, retry with backoff on transients,
         the per-shard circuit breaker, and — with replication on — failover
-        to the shard's successor-host replicas. ``fn(store)`` reads one
-        partition; the primary is tried first, then each replica. Returns
+        to the shard's successor-host replicas. ``fn`` is either a declared
+        transport op as an ``(op, args)`` tuple — the staging paths; the
+        primary fetch routes it through ``self.transport``, so in socket
+        mode it executes in the shard's worker process — or a plain
+        closure ``fn(store)`` (probe/drill paths; always parent-local,
+        closures cannot cross a process boundary). The primary is tried
+        first, then each replica. Returns
         (value, ok); ok=False means primary AND replicas all failed — the
         caller substitutes empty shard data so the compiled chain routes
         around the shard instead of crashing. A later successful primary
@@ -313,6 +335,9 @@ class ShardedDeviceStore:
 
         def attempt():
             faults.site("dist.shard_fetch", shard=i)
+            if isinstance(fn, tuple):
+                op, args = fn
+                return self.transport.fetch(i, self.stores[i], op, args)
             return fn(self.stores[i])
 
         # heat accounting (obs/heat.py): every fetch outcome charges this
@@ -387,7 +412,7 @@ class ShardedDeviceStore:
 
         def attempt(rg=rg, host=host):
             faults.site("replica.fetch", shard=host)
-            return fn(rg)
+            return _exec_local(fn, rg)
 
         try:
             out = retry_call(attempt, site=f"rotation.fetch[{i}@{host}]",
@@ -416,7 +441,7 @@ class ShardedDeviceStore:
         for host, rg in self.replicas.get(i, []):
             def attempt(rg=rg, host=host):
                 faults.site("replica.fetch", shard=host)
-                return fn(rg)
+                return _exec_local(fn, rg)
 
             try:
                 out = retry_call(attempt, site=f"replica.fetch[{i}->{host}]",
@@ -494,18 +519,11 @@ class ShardedDeviceStore:
             return self._cache[key]
         empty3 = (np.empty(0, np.int64), np.zeros(1, np.int64),
                   np.empty(0, np.int64))
-
-        def fetch(g):
-            if pid == TYPE_ID and int(d) == IN:
-                return self._type_csr(g)
-            host = g.segments.get(key)
-            return ((host.keys, host.offsets, host.edges)
-                    if host is not None else empty3)
-
         shards = []
         healthy = True
         for i in range(self.D):
-            got, ok = self._fetch_shard(i, fetch, f"segment({pid},{d})")
+            got, ok = self._fetch_shard(i, ("segment", key),
+                                        f"segment({pid},{d})")
             healthy &= ok
             shards.append(got if ok else empty3)
         if all(len(k) == 0 for (k, _, _) in shards):
@@ -553,11 +571,6 @@ class ShardedDeviceStore:
             self.bytes_used += seg.nbytes
         return seg
 
-    def _type_csr(self, g):
-        from wukong_tpu.engine.device_store import type_index_csr
-
-        return type_index_csr(g)
-
     def versatile_segment(self, d: int) -> StackedSegment | None:
         """Per-shard COMBINED adjacency of direction d, stacked over the
         mesh: every (predicate, neighbor) pair keyed by vid (the device form
@@ -569,15 +582,13 @@ class ShardedDeviceStore:
         key = ("vpv", int(d))
         if key in self._cache:
             return self._cache[key]
-        from wukong_tpu.engine.device_store import combined_adjacency
-
         empty4 = (np.empty(0, np.int64), np.zeros(1, np.int64),
                   np.empty(0, np.int64), np.empty(0, np.int64))
         shards = []
         healthy = True
         for i in range(self.D):
             got, ok = self._fetch_shard(
-                i, lambda g: combined_adjacency(g, d),
+                i, ("versatile", (int(d),)),
                 f"versatile_segment({d})")
             healthy &= ok
             shards.append(got if ok else empty4)
@@ -645,8 +656,7 @@ class ShardedDeviceStore:
         healthy = True
         for i in range(self.D):
             got, ok = self._fetch_shard(
-                i, lambda g: np.asarray(g.get_index(tpid, d),
-                                        dtype=np.int32),
+                i, ("index", (int(tpid), int(d))),
                 f"index_list({tpid},{d})")
             healthy &= ok
             lists.append(got if ok else np.empty(0, np.int32))

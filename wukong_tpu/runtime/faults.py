@@ -85,6 +85,12 @@ KNOWN_FAULT_SITES = frozenset({
     "vector.upsert",       # embedding upsert batch (vector/vstore.py;
                            # fires BEFORE the WAL append, so an injected
                            # failure leaves WAL and vstore both untouched)
+    "transport.connect",   # socket-transport peer connect (runtime/transport.py)
+    "transport.send",      # socket-transport frame send (fires before the
+                           # syscall, so an injected failure exercises the
+                           # drop-connection + reconnect + breaker path a
+                           # dead worker process does)
+    "transport.recv",      # socket-transport frame recv (same contract)
     "migration.clone",     # shard-migration snapshot (runtime/migration.py)
     "migration.catchup",   # shard-migration WAL-tail replay + dual-write
     "migration.cutover",   # shard-migration read-path swap

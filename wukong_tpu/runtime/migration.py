@@ -10,7 +10,10 @@ journaled (``shard.migrate.*``) and each of clone / catch-up / cutover an
 injectable fault site:
 
 1. **clone** — snapshot the donor shard's primary onto the recipient host
-   via the crash-consistent ``persist.clone_gstore`` path. The snapshot is
+   through the TRANSPORT seam (``Transport.snapshot``): the loopback
+   transport is the crash-consistent ``persist.clone_gstore`` structural
+   copy, the socket transport moves the shard through the checkpoint wire
+   codec (from its worker process when one serves it). The snapshot is
    taken under the WAL *mutation lock*, so it is exact at a recorded WAL
    high-water mark (``seq_clone``); the (long, in a real cluster) transfer
    then runs with writes flowing normally to the donor.
@@ -73,7 +76,6 @@ from wukong_tpu.config import Global
 from wukong_tpu.obs.events import emit_event
 from wukong_tpu.obs.metrics import get_registry
 from wukong_tpu.obs.placement import MigrationPlan, get_advisor, get_lineage
-from wukong_tpu.store.persist import clone_gstore
 from wukong_tpu.store.wal import active_wal, mutation_lock
 from wukong_tpu.utils.errors import ErrorCode, WukongError
 from wukong_tpu.utils.logger import log_info, log_warn
@@ -333,8 +335,11 @@ class MigrationExecutor:
     # ------------------------------------------------------------------
     def _phase_clone(self, job: MigrationJob) -> None:
         """Snapshot the donor under the mutation lock: exact at
-        ``seq_clone``, writes pause only for the in-memory copy (the
-        transfer a real cluster pays here runs unlocked)."""
+        ``seq_clone``, writes pause only for the copy. The copy itself is
+        a TRANSPORT transfer (runtime/transport.py ``snapshot``): loopback
+        is the in-memory structural clone (byte-for-byte PR 12 behavior);
+        the socket transport moves the shard through the checkpoint wire
+        codec — from its worker process when one serves it."""
         from wukong_tpu.runtime import faults
         from wukong_tpu.store.dynamic import enroll_migration_sink
 
@@ -353,7 +358,7 @@ class MigrationExecutor:
             job.seq_clone = (wal.next_seq - 1) if wal is not None else -1
             job.donor_store = ss.stores[donor]
             job.donor_host = ss.host_of(donor)
-            job.recipient = clone_gstore(job.donor_store)
+            job.recipient = ss.transport.snapshot(donor, job.donor_store)
             if wal is None:
                 # no WAL tail to catch up from: dual-write must start at
                 # the snapshot instant, inside this same critical section

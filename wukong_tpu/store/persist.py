@@ -21,6 +21,7 @@ lazily on the next insert.
 
 from __future__ import annotations
 
+import io
 import json
 import os
 import zipfile
@@ -97,7 +98,9 @@ def gstore_digest(g: GStore) -> int:
     return crc
 
 
-def save_gstore(g: GStore, path: str) -> None:
+def save_gstore(g: GStore, path) -> None:
+    """Persist a partition to ``path`` (a filename or any file object —
+    the transport's wire codec saves into a BytesIO)."""
     meta, arrays = _collect_arrays(g)
     meta["checksums"] = {name: _crc(a) for name, a in arrays.items()}
     arrays["_meta"] = np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8)
@@ -141,6 +144,13 @@ def load_gstore(path: str) -> GStore:
             json.JSONDecodeError) as e:
         raise CheckpointCorrupt(f"unreadable bundle: {e}",
                                 path=path) from None
+    return _decode_bundle(z, meta, path)
+
+
+def _decode_bundle(z, meta: dict, path: str) -> GStore:
+    """Validate + rebuild a partition from an opened npz — shared by the
+    on-disk load path and the transport wire codec, so a transport copy
+    is checked exactly as hard as a checkpoint restore."""
     if meta.get("format") is None:
         # version-1 bundle (pre-checksum): readable, but unverifiable
         log_warn(f"legacy gstore bundle (no format header): {path}")
@@ -185,6 +195,28 @@ def load_gstore(path: str) -> GStore:
                                 path=path) from None
     g.version = int(meta.get("store_version", 0))
     return g
+
+
+def gstore_to_bytes(g: GStore) -> bytes:
+    """One partition as checkpoint-format bytes: the transport's shard
+    snapshot payload (runtime/transport.py ``snapshot`` op). Same arrays,
+    same checksums, same digest surface as an on-disk bundle."""
+    buf = io.BytesIO()
+    save_gstore(g, buf)
+    return buf.getvalue()
+
+
+def gstore_from_bytes(blob: bytes) -> GStore:
+    """Inverse of :func:`gstore_to_bytes`, with the full load-path
+    validation (format header, per-array CRCs, structured errors)."""
+    try:
+        z = np.load(io.BytesIO(blob))
+        meta = json.loads(bytes(z["_meta"]).decode())
+    except (zipfile.BadZipFile, KeyError, OSError, ValueError,
+            json.JSONDecodeError) as e:
+        raise CheckpointCorrupt(f"unreadable bundle: {e}",
+                                path="<wire>") from None
+    return _decode_bundle(z, meta, "<wire>")
 
 
 # ---------------------------------------------------------------------------

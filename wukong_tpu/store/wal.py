@@ -289,42 +289,7 @@ class WriteAheadLog:
     # replay side
     # ------------------------------------------------------------------
     def _replay_segment(self, path: str, after_seq: int):
-        with open(path, "rb") as f:
-            data = f.read()
-        if not data.startswith(MAGIC):
-            raise CheckpointCorrupt("WAL segment missing magic", path=path)
-        off = len(MAGIC)
-        n = len(data)
-        while off < n:
-            if off + _HDR.size > n:
-                log_warn(f"WAL torn tail at {path}:{off} (short header); "
-                         "dropping the unacknowledged record")
-                _emit_wal_event("wal.torn_tail", path=path, offset=off,
-                                where="replay")
-                return
-            blen, crc = _HDR.unpack_from(data, off)
-            body = data[off + _HDR.size: off + _HDR.size + blen]
-            if len(body) < blen:
-                log_warn(f"WAL torn tail at {path}:{off} (short body); "
-                         "dropping the unacknowledged record")
-                _emit_wal_event("wal.torn_tail", path=path, offset=off,
-                                where="replay")
-                return
-            if zlib.crc32(body) != crc:
-                if off + _HDR.size + blen >= n:
-                    # final record: a torn in-place overwrite, same contract
-                    log_warn(f"WAL torn tail at {path}:{off} (bad crc on "
-                             "final record); dropping it")
-                    _emit_wal_event("wal.torn_tail", path=path, offset=off,
-                                    where="replay")
-                    return
-                raise CheckpointCorrupt(
-                    f"WAL crc mismatch mid-segment at offset {off}",
-                    path=path)
-            seq, kind, payload = pickle.loads(body)
-            if seq > after_seq:
-                yield WalRecord(seq=seq, kind=kind, payload=payload)
-            off += _HDR.size + blen
+        return replay_segment_file(path, after_seq)
 
     def replay(self, after_seq: int = -1):
         """Yield every durable record with seq > after_seq, oldest first."""
@@ -357,6 +322,74 @@ class WriteAheadLog:
                 os.remove(path)
                 removed += 1
         return removed
+
+
+# ---------------------------------------------------------------------------
+# read-only replay (module functions, no WriteAheadLog construction)
+#
+# Worker processes (runtime/procs.py) replay the PARENT's live WAL
+# directory to catch up after a checkpoint restore. They must never
+# construct a WriteAheadLog on it: the constructor repairs torn tails IN
+# PLACE (truncates the file), and a reader racing the parent's appender
+# would see a half-written final record as "torn" and destroy acknowledged
+# bytes. These functions read with the same corruption rules — torn tail
+# tolerated, mid-segment CRC fatal — and never open anything for writing.
+# ---------------------------------------------------------------------------
+
+def replay_segment_file(path: str, after_seq: int):
+    """Yield records with seq > after_seq from one segment, read-only."""
+    with open(path, "rb") as f:
+        data = f.read()
+    if not data.startswith(MAGIC):
+        raise CheckpointCorrupt("WAL segment missing magic", path=path)
+    off = len(MAGIC)
+    n = len(data)
+    while off < n:
+        if off + _HDR.size > n:
+            log_warn(f"WAL torn tail at {path}:{off} (short header); "
+                     "dropping the unacknowledged record")
+            _emit_wal_event("wal.torn_tail", path=path, offset=off,
+                            where="replay")
+            return
+        blen, crc = _HDR.unpack_from(data, off)
+        body = data[off + _HDR.size: off + _HDR.size + blen]
+        if len(body) < blen:
+            log_warn(f"WAL torn tail at {path}:{off} (short body); "
+                     "dropping the unacknowledged record")
+            _emit_wal_event("wal.torn_tail", path=path, offset=off,
+                            where="replay")
+            return
+        if zlib.crc32(body) != crc:
+            if off + _HDR.size + blen >= n:
+                # final record: a torn in-place overwrite, same contract
+                log_warn(f"WAL torn tail at {path}:{off} (bad crc on "
+                         "final record); dropping it")
+                _emit_wal_event("wal.torn_tail", path=path, offset=off,
+                                where="replay")
+                return
+            raise CheckpointCorrupt(
+                f"WAL crc mismatch mid-segment at offset {off}",
+                path=path)
+        seq, kind, payload = pickle.loads(body)
+        if seq > after_seq:
+            yield WalRecord(seq=seq, kind=kind, payload=payload)
+        off += _HDR.size + blen
+
+
+def replay_dir(dirname: str, after_seq: int = -1):
+    """Yield every durable record with seq > after_seq from a WAL
+    directory, oldest first, strictly read-only (a torn live tail is
+    skipped, never repaired — that is the owning appender's job)."""
+    segs = []
+    for name in os.listdir(dirname):
+        if name.startswith("wal-") and name.endswith(".log"):
+            try:
+                first = int(name[4:-4])
+            except ValueError:
+                continue
+            segs.append((first, os.path.join(dirname, name)))
+    for _first, path in sorted(segs):
+        yield from replay_segment_file(path, after_seq)
 
 
 # ---------------------------------------------------------------------------

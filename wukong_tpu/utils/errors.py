@@ -34,6 +34,8 @@ class ErrorCode(enum.IntEnum):
     SHARD_UNAVAILABLE = 17  # shard down / circuit breaker open
     RETRY_EXHAUSTED = 18  # transient-failure retries used up
     CHECKPOINT_CORRUPT = 19  # checkpoint/WAL bundle unreadable or mismatched
+    FRAME_TOO_LARGE = 20  # transport frame over transport_max_frame_mb
+    TRANSPORT_CORRUPT = 21  # wire frame/message failed CRC or schema checks
 
 
 _MESSAGES = {
@@ -57,6 +59,8 @@ _MESSAGES = {
     ErrorCode.SHARD_UNAVAILABLE: "shard unavailable (circuit open)",
     ErrorCode.RETRY_EXHAUSTED: "transient-failure retries exhausted",
     ErrorCode.CHECKPOINT_CORRUPT: "checkpoint/WAL bundle corrupt or incompatible",
+    ErrorCode.FRAME_TOO_LARGE: "transport frame exceeds transport_max_frame_mb",
+    ErrorCode.TRANSPORT_CORRUPT: "transport frame or message corrupt",
 }
 
 
@@ -119,6 +123,26 @@ class CheckpointCorrupt(WukongError):
         self.path = path
         super().__init__(ErrorCode.CHECKPOINT_CORRUPT,
                          f"{detail} ({path})" if path else detail)
+
+
+class FrameTooLarge(WukongError):
+    """A transport frame (sent or received) exceeds the configured
+    ``transport_max_frame_mb`` ceiling. Raised on the ENCODE side too:
+    the sender must refuse what the receiver would refuse, or the error
+    surfaces as an opaque peer timeout instead of a named limit."""
+
+    def __init__(self, detail: str = ""):
+        super().__init__(ErrorCode.FRAME_TOO_LARGE, detail)
+
+
+class TransportCorrupt(WukongError):
+    """A wire frame or message failed validation: bad magic, CRC mismatch
+    on a complete frame, an undeclared op, or a request/reply that does
+    not match its MESSAGE_REGISTRY schema. Distinct from a torn trailing
+    frame, which is silently dropped (only the unacknowledged message)."""
+
+    def __init__(self, detail: str = ""):
+        super().__init__(ErrorCode.TRANSPORT_CORRUPT, detail)
 
 
 def assert_ec(cond: bool, code: ErrorCode, detail: str = "") -> None:
